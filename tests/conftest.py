@@ -1,0 +1,14 @@
+"""Test-session configuration.
+
+JAX-touching tests (ops/parallel/graft-entry) run on a virtual 8-device
+CPU mesh; the env vars must be set before jax is first imported, so they
+are set here at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()

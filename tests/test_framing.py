@@ -1,0 +1,163 @@
+"""Framing-layer tests: incremental frame slicing, length guards, and the
+symmetric client/server PacketCodec (reference behavior: lib/zk-streams.js)."""
+
+import pytest
+
+from zkstream_tpu.protocol.consts import MAX_PACKET
+from zkstream_tpu.protocol.errors import ZKProtocolError
+from zkstream_tpu.protocol.framing import FrameDecoder, PacketCodec, frame
+
+
+def test_frame_helper():
+    assert frame(b'abc') == b'\x00\x00\x00\x03abc'
+    assert frame(b'') == b'\x00\x00\x00\x00'
+
+
+def test_single_frame():
+    d = FrameDecoder()
+    assert d.feed(frame(b'hello')) == [b'hello']
+    assert d.pending() == 0
+
+
+def test_multiple_frames_one_chunk():
+    d = FrameDecoder()
+    data = frame(b'one') + frame(b'two') + frame(b'three')
+    assert d.feed(data) == [b'one', b'two', b'three']
+
+
+def test_byte_at_a_time():
+    d = FrameDecoder()
+    data = frame(b'slow') + frame(b'drip')
+    got = []
+    for i in range(len(data)):
+        got += d.feed(data[i:i + 1])
+    assert got == [b'slow', b'drip']
+
+
+def test_split_across_chunks():
+    d = FrameDecoder()
+    data = frame(b'x' * 1000)
+    assert d.feed(data[:500]) == []
+    assert d.feed(data[500:]) == [b'x' * 1000]
+
+
+def test_negative_length_rejected():
+    d = FrameDecoder()
+    with pytest.raises(ZKProtocolError) as ei:
+        d.feed(b'\xff\xff\xff\xf6')
+    assert ei.value.code == 'BAD_LENGTH'
+
+
+def test_oversized_length_rejected():
+    d = FrameDecoder()
+    too_big = (MAX_PACKET + 1).to_bytes(4, 'big')
+    with pytest.raises(ZKProtocolError) as ei:
+        d.feed(too_big)
+    assert ei.value.code == 'BAD_LENGTH'
+
+
+def test_max_packet_boundary_accepted():
+    d = FrameDecoder()
+    body = b'\x00' * MAX_PACKET
+    out = d.feed(frame(body))
+    assert len(out) == 1 and len(out[0]) == MAX_PACKET
+
+
+def test_zero_length_frame():
+    d = FrameDecoder()
+    assert d.feed(frame(b'') + frame(b'a')) == [b'', b'a']
+
+
+def test_codec_client_server_handshake_and_request():
+    """Drive a client codec against a server codec end to end."""
+    client = PacketCodec()
+    server = PacketCodec(server=True)
+
+    creq = {'protocolVersion': 0, 'lastZxidSeen': 0, 'timeOut': 30000,
+            'sessionId': 0, 'passwd': b'\x00' * 16}
+    wire = client.encode(creq)
+    [got] = server.decode(wire)
+    assert got == creq
+
+    cresp = {'protocolVersion': 0, 'timeOut': 30000, 'sessionId': 0x1234,
+             'passwd': b'p' * 16}
+    wire = server.encode(cresp)
+    [got] = client.decode(wire)
+    assert got == cresp
+
+    # Handshake complete on both ends.
+    client.handshaking = False
+    server.handshaking = False
+
+    req = {'xid': 1, 'opcode': 'GET_DATA', 'path': '/x', 'watch': True}
+    [got] = server.decode(client.encode(req))
+    assert got == req
+    assert client.xid_map[1] == 'GET_DATA'
+
+    resp = {'xid': 1, 'zxid': 5, 'err': 'OK', 'opcode': 'GET_DATA',
+            'data': b'v', 'stat': __import__(
+                'zkstream_tpu.protocol.records', fromlist=['Stat']).Stat()}
+    [got] = client.decode(server.encode(resp))
+    assert got['data'] == b'v'
+    assert got['err'] == 'OK'
+
+
+def test_codec_bad_decode_raises_protocol_error():
+    client = PacketCodec()
+    client.handshaking = False
+    # A garbage frame in steady state: xid matches nothing.
+    with pytest.raises(ZKProtocolError) as ei:
+        client.decode(frame(b'\x00\x00\x00\x63' + b'\x00' * 12))
+    assert ei.value.code == 'BAD_DECODE'
+
+
+def test_codec_truncated_body_raises_bad_decode():
+    client = PacketCodec()
+    # ConnectResponse body far too short.
+    with pytest.raises(ZKProtocolError) as ei:
+        client.decode(frame(b'\x00\x00'))
+    assert ei.value.code == 'BAD_DECODE'
+
+
+def test_packets_before_bad_frame_are_preserved():
+    # A valid notification sharing a chunk with a corrupt frame must still
+    # be delivered: it rides on err.packets.
+    from zkstream_tpu.protocol.jute import JuteWriter
+    from zkstream_tpu.protocol.records import write_response
+
+    client = PacketCodec()
+    client.handshaking = False
+    w = JuteWriter()
+    write_response(w, {'xid': -1, 'zxid': 1, 'err': 'OK',
+                       'opcode': 'NOTIFICATION', 'type': 'DATA_CHANGED',
+                       'state': 'SYNC_CONNECTED', 'path': '/watched'})
+    good = frame(w.to_bytes())
+    bad = frame(b'\x00\x00\x00\x63' + b'\x00' * 12)
+    with pytest.raises(ZKProtocolError) as ei:
+        client.decode(good + bad)
+    assert ei.value.code == 'BAD_DECODE'
+    assert len(ei.value.packets) == 1
+    assert ei.value.packets[0]['path'] == '/watched'
+
+
+def test_xid_map_entry_consumed_by_reply():
+    # One reply per xid: the map must not grow without bound.
+    from zkstream_tpu.protocol.jute import JuteWriter
+    from zkstream_tpu.protocol.records import write_response
+
+    client = PacketCodec()
+    client.handshaking = False
+    client.encode({'xid': 1, 'opcode': 'PING'})
+    assert 1 in client.xid_map
+    w = JuteWriter()
+    write_response(w, {'xid': 1, 'zxid': 1, 'err': 'OK', 'opcode': 'PING'})
+    [pkt] = client.decode(frame(w.to_bytes()))
+    assert pkt['opcode'] == 'PING'
+    assert 1 not in client.xid_map
+
+
+def test_server_mode_bad_decode_names_request():
+    server = PacketCodec(server=True)
+    server.handshaking = False
+    with pytest.raises(ZKProtocolError, match='Failed to decode Request'):
+        server.decode(frame(b'\x00\x00\x00\x01\x00\x00\x00\x63'))

@@ -1,0 +1,177 @@
+"""Jute primitive codec tests: round-trips, wire quirks, bounds checks
+(reference behavior: lib/jute-buffer.js)."""
+
+import random
+
+import pytest
+
+from zkstream_tpu.protocol.jute import (
+    JuteReader,
+    JuteTruncatedError,
+    JuteValueError,
+    JuteWriter,
+)
+
+
+def roundtrip(write_fn, read_name):
+    w = JuteWriter()
+    write_fn(w)
+    r = JuteReader(w.to_bytes())
+    return r, getattr(r, read_name)
+
+
+def test_int_wire_format():
+    w = JuteWriter()
+    w.write_int(0x01020304)
+    assert w.to_bytes() == b'\x01\x02\x03\x04'
+    w = JuteWriter()
+    w.write_int(-1)
+    assert w.to_bytes() == b'\xff\xff\xff\xff'
+
+
+def test_long_wire_format():
+    w = JuteWriter()
+    w.write_long(0x0102030405060708)
+    assert w.to_bytes() == b'\x01\x02\x03\x04\x05\x06\x07\x08'
+    w = JuteWriter()
+    w.write_long(-2)
+    assert w.to_bytes() == b'\xff' * 7 + b'\xfe'
+
+
+def test_int_range_checks():
+    w = JuteWriter()
+    with pytest.raises(JuteValueError):
+        w.write_int(1 << 31)
+    with pytest.raises(JuteValueError):
+        w.write_long(1 << 63)
+
+
+def test_bool_roundtrip_and_validation():
+    w = JuteWriter()
+    w.write_bool(True)
+    w.write_bool(False)
+    r = JuteReader(w.to_bytes())
+    assert r.read_bool() is True
+    assert r.read_bool() is False
+    with pytest.raises(JuteValueError):
+        JuteReader(b'\x02').read_bool()
+
+
+def test_byte_signed_roundtrip():
+    w = JuteWriter()
+    for v in (-128, -1, 0, 1, 127):
+        w.write_byte(v)
+    r = JuteReader(w.to_bytes())
+    assert [r.read_byte() for _ in range(5)] == [-128, -1, 0, 1, 127]
+
+
+def test_empty_buffer_encodes_as_minus_one():
+    # Reference quirk: empty buffer -> length -1 on the wire
+    # (lib/jute-buffer.js:127-130).
+    w = JuteWriter()
+    w.write_buffer(b'')
+    assert w.to_bytes() == b'\xff\xff\xff\xff'
+
+
+def test_negative_length_reads_as_empty():
+    # Reference quirk: negative length decodes to the empty buffer
+    # (lib/jute-buffer.js:99-100).
+    r = JuteReader(b'\xff\xff\xff\xff')
+    assert r.read_buffer() == b''
+
+
+def test_buffer_roundtrip():
+    payload = bytes(range(256))
+    w = JuteWriter()
+    w.write_buffer(payload)
+    r = JuteReader(w.to_bytes())
+    assert r.read_buffer() == payload
+    assert r.at_end()
+
+
+def test_ustring_roundtrip_unicode():
+    s = 'héllo /ζookeeper ✓'
+    w = JuteWriter()
+    w.write_ustring(s)
+    r = JuteReader(w.to_bytes())
+    assert r.read_ustring() == s
+
+
+def test_truncated_reads_raise():
+    with pytest.raises(JuteTruncatedError):
+        JuteReader(b'\x00\x00').read_int()
+    with pytest.raises(JuteTruncatedError):
+        JuteReader(b'\x00\x00\x00\x00').read_long()
+    # Buffer whose declared length exceeds available bytes:
+    with pytest.raises(JuteTruncatedError):
+        JuteReader(b'\x00\x00\x00\x09abc').read_buffer()
+
+
+def test_length_prefixed_scopes():
+    w = JuteWriter()
+
+    def inner(sub):
+        sub.write_int(7)
+        sub.write_ustring('abc')
+
+    w.write_length_prefixed(inner)
+    data = w.to_bytes()
+    # 4 (int) + 4+3 (string) = 11 bytes inside the scope.
+    assert data[:4] == b'\x00\x00\x00\x0b'
+
+    r = JuteReader(data)
+
+    def read_inner(sub):
+        assert sub.read_int() == 7
+        assert sub.read_ustring() == 'abc'
+        return 'done'
+
+    assert r.read_length_prefixed(read_inner) == 'done'
+    assert r.at_end()
+
+
+def test_length_prefixed_scope_skips_unconsumed_bytes():
+    w = JuteWriter()
+
+    def inner(sub):
+        sub.write_int(1)
+        sub.write_int(2)
+
+    w.write_length_prefixed(inner)
+    w.write_int(99)
+    r = JuteReader(w.to_bytes())
+    # Consume only part of the scope; the reader must still land after it.
+    r.read_length_prefixed(lambda sub: sub.read_int())
+    assert r.read_int() == 99
+
+
+def test_property_roundtrip_fuzz():
+    rng = random.Random(1303)
+    for _ in range(200):
+        ints = [rng.randint(-(1 << 31), (1 << 31) - 1) for _ in range(3)]
+        longs = [rng.randint(-(1 << 63), (1 << 63) - 1) for _ in range(3)]
+        bufs = [rng.randbytes(rng.randint(0, 64)) for _ in range(2)]
+        strs = [''.join(chr(rng.randint(32, 0x2FF))
+                        for _ in range(rng.randint(0, 16)))
+                for _ in range(2)]
+        bools = [rng.random() < 0.5 for _ in range(2)]
+
+        w = JuteWriter()
+        for v in ints:
+            w.write_int(v)
+        for v in longs:
+            w.write_long(v)
+        for v in bufs:
+            w.write_buffer(v)
+        for v in strs:
+            w.write_ustring(v)
+        for v in bools:
+            w.write_bool(v)
+
+        r = JuteReader(w.to_bytes())
+        assert [r.read_int() for _ in range(3)] == ints
+        assert [r.read_long() for _ in range(3)] == longs
+        assert [r.read_buffer() for _ in range(2)] == bufs
+        assert [r.read_ustring() for _ in range(2)] == strs
+        assert [r.read_bool() for _ in range(2)] == bools
+        assert r.at_end()

@@ -1,0 +1,20 @@
+"""zkstream_tpu — a from-scratch Python rebuild of the capabilities of
+TritonDataCenter/node-zkstream: a minimal, streams-oriented ZooKeeper
+wire-protocol client (Jute codec, length-prefixed framing, connection and
+session state machines, watcher engine with lost-wakeup self-checking,
+ensemble failover with session resumption), plus an in-process ZooKeeper
+server for tests.
+
+The reference (mounted at /root/reference) is pure JavaScript with zero
+native components and no ML workload; see SURVEY.md and BASELINE.json for
+the structural analysis.
+"""
+
+__version__ = '0.1.0'
+
+from .protocol.errors import (  # noqa: F401
+    ZKError,
+    ZKNotConnectedError,
+    ZKPingTimeoutError,
+    ZKProtocolError,
+)

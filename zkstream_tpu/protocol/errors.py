@@ -1,0 +1,57 @@
+"""Error classes for the zkstream_tpu client.
+
+Mirrors the reference's four error classes (reference: lib/errors.js:9-54):
+transport/framing problems, ping timeouts, not-connected, and server-side
+operation errors.
+"""
+
+from __future__ import annotations
+
+from .consts import ERR_TEXT, ErrCode
+
+
+class ZKProtocolError(Exception):
+    """A transport- or framing-level protocol problem (bad length prefix,
+    undecodable packet, version mismatch...).  ``code`` is a short
+    machine-readable string such as ``'BAD_LENGTH'`` or ``'BAD_DECODE'``
+    (reference: lib/errors.js:19-28)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class ZKPingTimeoutError(ZKProtocolError):
+    """The server failed to answer a keep-alive ping in time
+    (reference: lib/errors.js:30-35)."""
+
+    def __init__(self) -> None:
+        super().__init__('PING_TIMEOUT', 'Timed out while waiting for ping '
+            'reply from ZK server')
+
+
+class ZKNotConnectedError(ZKProtocolError):
+    """An operation was attempted while no usable connection exists
+    (reference: lib/errors.js:37-42)."""
+
+    def __init__(self) -> None:
+        super().__init__('CONNECTION_LOSS',
+            'Not connected to a ZooKeeper server')
+
+
+class ZKError(Exception):
+    """A server-side operation error: the reply header carried a non-OK
+    error code (reference: lib/errors.js:44-54).  ``code`` is the error
+    name (e.g. ``'NO_NODE'``); ``errno`` the numeric protocol code."""
+
+    def __init__(self, code: str, message: str | None = None):
+        if message is None:
+            message = ERR_TEXT.get(code) or code
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        try:
+            self.errno: int | None = int(ErrCode[code])
+        except KeyError:
+            self.errno = None

@@ -1,0 +1,139 @@
+"""Length-prefixed framing and the stateful packet codec.
+
+Every ZooKeeper message travels as a 4-byte big-endian length prefix
+followed by that many body bytes.  ``FrameDecoder`` is an incremental
+accumulator that slices complete frames out of an arbitrary byte stream
+and rejects insane lengths (negative, or over the 16 MiB cap)
+(reference: lib/zk-streams.js:39-64, cap at :23).
+
+``PacketCodec`` layers the message codec on top: it tracks whether the
+link is still handshaking (connect req/resp framing differs from the
+steady-state request/reply framing) and keeps the xid -> opcode map the
+reply decoder needs.  Like the reference's streams it is symmetric —
+``server=True`` flips the direction so the same codec drives an
+in-process ZooKeeper *server* for tests
+(reference: lib/zk-streams.js:28,70-71,84-85,128-129).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from . import records
+from .consts import MAX_PACKET
+from .errors import ZKProtocolError
+from .jute import JuteReader, JuteWriter
+
+_LEN = struct.Struct('>i')
+
+
+class FrameDecoder:
+    """Incremental splitter of a byte stream into length-prefixed frames."""
+
+    __slots__ = ('_buf',)
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> list[bytes]:
+        """Absorb ``chunk``; return every complete frame body now
+        available.  Raises ZKProtocolError('BAD_LENGTH') on a negative or
+        oversized length prefix (reference: lib/zk-streams.js:47-53)."""
+        self._buf += chunk
+        frames: list[bytes] = []
+        off = 0
+        try:
+            while len(self._buf) - off >= 4:
+                (ln,) = _LEN.unpack_from(self._buf, off)
+                if ln < 0 or ln > MAX_PACKET:
+                    raise ZKProtocolError('BAD_LENGTH',
+                        'Invalid ZK packet length %d' % (ln,))
+                if len(self._buf) - off < 4 + ln:
+                    break
+                frames.append(bytes(self._buf[off + 4:off + 4 + ln]))
+                off += 4 + ln
+        finally:
+            if off:
+                del self._buf[:off]
+        return frames
+
+    def pending(self) -> int:
+        """Bytes buffered but not yet sliced into a frame."""
+        return len(self._buf)
+
+
+def frame(body: bytes) -> bytes:
+    """Wrap an encoded message body in its length prefix."""
+    return _LEN.pack(len(body)) + body
+
+
+class PacketCodec:
+    """Stateful bytes <-> packet-dict codec for one TCP connection.
+
+    ``handshaking`` starts True; the connection layer flips it to False
+    once the connect exchange completes, switching both directions to the
+    request/reply formats (reference: lib/zk-streams.js:68,126).
+    """
+
+    def __init__(self, server: bool = False):
+        self._decoder = FrameDecoder()
+        self._server = server
+        self.handshaking = True
+        #: xid -> opcode for replies in flight
+        #: (reference: lib/zk-streams.js:145, connection-fsm.js:74).
+        self.xid_map: dict[int, str] = {}
+
+    def encode(self, pkt: dict) -> bytes:
+        """Encode one outgoing packet to framed wire bytes."""
+        w = JuteWriter()
+        if self.handshaking:
+            if self._server:
+                records.write_connect_response(w, pkt)
+            else:
+                records.write_connect_request(w, pkt)
+        elif self._server:
+            records.write_response(w, pkt)
+        else:
+            records.write_request(w, pkt)
+            self.xid_map[pkt['xid']] = pkt['opcode']
+        return frame(w.to_bytes())
+
+    def decode(self, chunk: bytes) -> list[dict]:
+        """Absorb incoming bytes; return the packets completed by them.
+
+        Framing errors raise ZKProtocolError('BAD_LENGTH'); undecodable
+        frame bodies raise ZKProtocolError('BAD_DECODE')
+        (reference: lib/zk-streams.js:49-51,74-79,90-95).  When a later
+        frame in the chunk fails, packets decoded before it are attached
+        to the error as ``err.packets`` so the caller can still deliver
+        them (e.g. a watch notification sharing a TCP segment with a
+        corrupt frame must not be lost — ZK will never refire it).
+        """
+        pkts: list[dict] = []
+        for body in self._decoder.feed(chunk):
+            r = JuteReader(body)
+            try:
+                if self.handshaking:
+                    if self._server:
+                        pkt = records.read_connect_request(r)
+                    else:
+                        pkt = records.read_connect_response(r)
+                elif self._server:
+                    pkt = records.read_request(r)
+                else:
+                    pkt = records.read_response(r, self.xid_map)
+            except Exception as e:
+                if isinstance(e, ZKProtocolError):
+                    err = e
+                else:
+                    what = ('ConnectRequest' if self._server else
+                            'ConnectResponse') if self.handshaking else (
+                            'Request' if self._server else 'Response')
+                    err = ZKProtocolError('BAD_DECODE',
+                        'Failed to decode %s: %s: %s' % (
+                            what, type(e).__name__, e))
+                    err.__cause__ = e
+                err.packets = pkts
+                raise err
+            pkts.append(pkt)
+        return pkts
