@@ -5,10 +5,51 @@ CPU mesh; the env vars must be set before jax is first imported, so they
 are set here at conftest import time.
 """
 
+import asyncio
+import inspect
 import os
+
+import pytest
 
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+
+# -- minimal async-test support (pytest-asyncio is not in the image) --
+
+@pytest.fixture
+def event_loop():
+    """One fresh event loop per test; fixtures drive it explicitly."""
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    loop.run_until_complete(loop.shutdown_asyncgens())
+    asyncio.set_event_loop(None)
+    loop.close()
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests on the test's event_loop fixture."""
+    if not inspect.iscoroutinefunction(pyfuncitem.obj):
+        return None
+    loop = pyfuncitem.funcargs.get('event_loop')
+    own_loop = loop is None
+    if own_loop:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+    try:
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames
+                  if name in pyfuncitem.funcargs}
+        loop.run_until_complete(
+            asyncio.wait_for(pyfuncitem.obj(**kwargs), timeout=30))
+    finally:
+        if own_loop:
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            asyncio.set_event_loop(None)
+            loop.close()
+    return True

@@ -12,9 +12,12 @@ the structural analysis.
 
 __version__ = '0.1.0'
 
+from .client import Client  # noqa: F401
+from .protocol.consts import CreateFlag, Perm  # noqa: F401
 from .protocol.errors import (  # noqa: F401
     ZKError,
     ZKNotConnectedError,
     ZKPingTimeoutError,
     ZKProtocolError,
 )
+from .protocol.records import ACL, OPEN_ACL_UNSAFE, Id, Stat  # noqa: F401

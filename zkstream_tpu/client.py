@@ -1,0 +1,354 @@
+"""The public client API.
+
+``Client`` is the user-facing facade over the pool/connection/session
+machinery (reference: lib/client.js:31-601): an event emitter
+(``session``, ``connect``, ``disconnect``, ``expire``, ``failed``,
+``close``) plus awaitable znode operations.  Where the reference's ops
+take callbacks, these are coroutines; semantics are otherwise the same,
+including ``create_with_empty_parents`` parent tolerance and the
+deferred ``connect`` emission (the event only fires once the connection
+is actually usable for requests).
+
+Usage::
+
+    client = Client(address='127.0.0.1', port=2181)
+    client.start()
+    await client.wait_connected()
+    await client.create('/x', b'hello')
+    data, stat = await client.get('/x')
+    w = client.watcher('/x')
+    w.on('dataChanged', lambda data, stat: ...)
+    await client.close()
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from .io.connection import Backend, ZKConnection
+from .io.pool import (
+    DEFAULT_CONNECT_POLICY,
+    DEFAULT_DECOHERENCE_INTERVAL,
+    DEFAULT_POLICY,
+    ConnectionPool,
+    RecoveryPolicy,
+)
+from .io.session import ZKSession
+from .io.watcher import ZKWatcher
+from .protocol.consts import CreateFlag
+from .protocol.errors import ZKNotConnectedError
+from .protocol.records import OPEN_ACL_UNSAFE, Stat
+from .utils.fsm import FSM
+from .utils.metrics import Collector
+
+log = logging.getLogger('zkstream_tpu.client')
+
+METRIC_ZK_EVENT_COUNTER = 'zookeeper_events'
+
+#: Default session timeout, ms (reference: lib/client.js:80-83).
+DEFAULT_SESSION_TIMEOUT = 30000
+
+
+class Client(FSM):
+    def __init__(self, address: str | None = None, port: int = 2181,
+                 servers: list[tuple[str, int]] | None = None,
+                 session_timeout: int = DEFAULT_SESSION_TIMEOUT,
+                 collector: Collector | None = None,
+                 connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
+                 default_policy: RecoveryPolicy = DEFAULT_POLICY,
+                 decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
+                 shuffle_backends: bool = True,
+                 seed: int | None = None):
+        if servers is None:
+            assert address is not None, 'address or servers[] required'
+            backends = [Backend(address, port)]
+        else:
+            backends = [Backend(a, p) for (a, p) in servers]
+
+        self.collector = collector if collector is not None else Collector()
+        self.collector.counter(METRIC_ZK_EVENT_COUNTER,
+            'Total number of zookeeper events')
+
+        self.session_timeout = session_timeout
+        self.session: ZKSession | None = None
+        self.old_session: ZKSession | None = None
+
+        self.pool = ConnectionPool(
+            self, backends,
+            connect_policy=connect_policy,
+            default_policy=default_policy,
+            decoherence_interval=decoherence_interval,
+            shuffle=shuffle_backends, seed=seed)
+        self.pool.on('stateChanged', self._on_pool_state_changed)
+
+        self._started = False
+        super().__init__('normal')
+
+    # -- lifecycle (reference: lib/client.js:127-215) --
+
+    def state_normal(self, S) -> None:
+        self._new_session()
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closing'))
+
+    def state_closing(self, S) -> None:
+        """Close the session first — its closing state drains the
+        connection and sends CLOSE_SESSION, which is what deletes
+        ephemerals immediately instead of at expiry — then stop the
+        pool before it can redial (reference: lib/client.js:135-177
+        shuts session/set/resolver down concurrently and relies on the
+        session winning the race; sequencing makes it deterministic)."""
+
+        def finish():
+            self.pool.stop()
+            S.goto_state('closed')
+
+        if self.session.is_in_state('closed') or \
+           self.session.is_in_state('expired'):
+            finish()
+            return
+
+        def on_session_state(st):
+            if st in ('closed', 'expired'):
+                finish()
+        S.on(self.session, 'stateChanged', on_session_state)
+        self.session.close()
+
+    def state_closed(self, S) -> None:
+        self.emit('close')
+
+    def start(self) -> None:
+        """Begin connecting.  Separate from __init__ so the caller
+        controls which running event loop the client binds to (the
+        reference starts its resolver in the constructor)."""
+        assert not self._started, 'client already started'
+        self._started = True
+        self.pool.start()
+
+    async def close(self) -> None:
+        """Close the session cleanly and stop the pool."""
+        if self.is_in_state('closed'):
+            return
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.once('close', lambda: fut.done() or fut.set_result(None))
+        self.emit('closeAsserted')
+        await fut
+
+    # -- session management (reference: lib/client.js:187-273) --
+
+    def _new_session(self) -> None:
+        if not self.is_in_state('normal'):
+            return
+        s = ZKSession(self.session_timeout, self.collector)
+        self.session = s
+
+        def initial_handler(st):
+            if st == 'attached':
+                s.remove_listener('stateChanged', initial_handler)
+                s.on('stateChanged', final_handler)
+                self._emit_after_connected('session')
+                self._emit_after_connected('connect')
+
+        def final_handler(st):
+            if st == 'attached':
+                self._emit_after_connected('connect')
+            elif st == 'detached':
+                self.emit('disconnect')
+            elif st == 'expired':
+                self.emit('expire')
+        s.on('stateChanged', initial_handler)
+
+    def get_session(self) -> ZKSession | None:
+        """The live session; a session that expired or closed is lazily
+        replaced (reference: lib/client.js:264-273)."""
+        if not self.is_in_state('normal'):
+            return None
+        if self.session.is_in_state('expired') or \
+           self.session.is_in_state('closed'):
+            self.old_session = self.session
+            self._new_session()
+        return self.session
+
+    def _event_track(self, evt: str) -> None:
+        if evt in ('session', 'connect', 'failed'):
+            self.collector.get_collector(
+                METRIC_ZK_EVENT_COUNTER).increment({'evtype': evt})
+
+    def _emit_after_connected(self, evt: str) -> None:
+        """Defer an event until the connection can actually serve
+        requests (reference: lib/client.js:237-262)."""
+        conn = self.current_connection()
+        if conn is None:
+            return
+        loop = asyncio.get_event_loop()
+        if conn.is_in_state('connected'):
+            def fire():
+                self._event_track(evt)
+                self.emit(evt)
+            loop.call_soon(fire)
+        else:
+            def on_conn_ch(cst):
+                if cst == 'connected':
+                    conn.remove_listener('stateChanged', on_conn_ch)
+                    self._event_track(evt)
+                    self.emit(evt)
+            conn.on('stateChanged', on_conn_ch)
+
+    def _on_pool_state_changed(self, st: str) -> None:
+        if st == 'failed':
+            def fire():
+                self._event_track('failed')
+                self.emit('failed', ZKNotConnectedError())
+            asyncio.get_event_loop().call_soon(fire)
+
+    # -- connection access --
+
+    def current_connection(self) -> ZKConnection | None:
+        sess = self.get_session()
+        if sess is None:
+            return None
+        return sess.get_connection()
+
+    def is_connected(self) -> bool:
+        conn = self.current_connection()
+        return conn is not None and conn.is_in_state('connected')
+
+    async def wait_connected(self, timeout: float | None = None) -> None:
+        """Convenience: wait until the client is usable (or raise on
+        terminal failure / timeout)."""
+        if self.is_connected():
+            return
+        if self.pool.state == 'failed':
+            # 'failed' is edge-triggered; a pool already in monitor mode
+            # will not re-emit it, so report the failure immediately.
+            raise ZKNotConnectedError()
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_connect():
+            if not fut.done():
+                fut.set_result(None)
+
+        def on_failed(err):
+            if not fut.done():
+                fut.set_exception(err)
+        self.on('connect', on_connect)
+        self.on('failed', on_failed)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        finally:
+            self.remove_listener('connect', on_connect)
+            self.remove_listener('failed', on_failed)
+
+    def _conn_or_raise(self) -> ZKConnection:
+        conn = self.current_connection()
+        if conn is None or not conn.is_in_state('connected'):
+            raise ZKNotConnectedError()
+        return conn
+
+    # -- operations (reference: lib/client.js:318-601) --
+
+    async def ping(self) -> float:
+        """Round-trip a ping; resolves to the latency in ms."""
+        conn = self._conn_or_raise()
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def cb(err, latency):
+            if fut.done():
+                return
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result(latency)
+        conn.ping(cb)
+        return await fut
+
+    async def list(self, path: str) -> tuple[list[str], Stat]:
+        """Children of a znode, with its stat."""
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'GET_CHILDREN2', 'path': path,
+                                  'watch': False}).as_future()
+        return pkt['children'], pkt['stat']
+
+    async def get(self, path: str) -> tuple[bytes, Stat]:
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'GET_DATA', 'path': path,
+                                  'watch': False}).as_future()
+        return pkt['data'], pkt['stat']
+
+    async def create(self, path: str, data: bytes,
+                     acl=None, flags: CreateFlag | int = 0) -> str:
+        """Create a znode; resolves to the created path (which differs
+        from the request path for SEQUENTIAL nodes)."""
+        if acl is None:
+            acl = list(OPEN_ACL_UNSAFE)
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'CREATE', 'path': path,
+                                  'data': data, 'acl': acl,
+                                  'flags': CreateFlag(flags)}).as_future()
+        return pkt['path']
+
+    async def create_with_empty_parents(self, path: str, data: bytes,
+                                        acl=None,
+                                        flags: CreateFlag | int = 0) -> str:
+        """Create a znode, creating any missing parents as plain
+        persistent nodes with data b'null'; NODE_EXISTS on a parent is
+        fine, on the leaf it is an error.  Options apply only to the
+        leaf (reference: lib/client.js:412-481)."""
+        from .protocol.errors import ZKError
+
+        nodes = path.split('/')[1:]
+        current = ''
+        result = None
+        for i, node in enumerate(nodes):
+            current = current + '/' + node
+            last = (i == len(nodes) - 1)
+            try:
+                result = await self.create(
+                    current,
+                    data if last else b'null',
+                    acl=acl if last else None,
+                    flags=flags if last else 0)
+            except ZKError as e:
+                if last or e.code != 'NODE_EXISTS':
+                    raise
+        return result
+
+    async def set(self, path: str, data: bytes,
+                  version: int = -1) -> Stat:
+        """Set a znode's data; resolves to the new stat.  (The reference
+        passes its callback a path field SET_DATA replies do not carry,
+        lib/client.js:503-504 — the stat is the useful payload.)"""
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'SET_DATA', 'path': path,
+                                  'data': data,
+                                  'version': version}).as_future()
+        return pkt['stat']
+
+    async def delete(self, path: str, version: int) -> None:
+        conn = self._conn_or_raise()
+        await conn.request({'opcode': 'DELETE', 'path': path,
+                            'version': version}).as_future()
+
+    async def stat(self, path: str) -> Stat:
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'EXISTS', 'path': path,
+                                  'watch': False}).as_future()
+        return pkt['stat']
+
+    async def get_acl(self, path: str):
+        conn = self._conn_or_raise()
+        pkt = await conn.request({'opcode': 'GET_ACL',
+                                  'path': path}).as_future()
+        return pkt['acl']
+
+    async def sync(self, path: str) -> None:
+        """Flush the leader pipeline to the connected server
+        (reference: lib/client.js:578-597)."""
+        conn = self._conn_or_raise()
+        await conn.request({'opcode': 'SYNC', 'path': path}).as_future()
+
+    def watcher(self, path: str) -> ZKWatcher:
+        return self.get_session().watcher(path)

@@ -1,0 +1,470 @@
+"""The per-TCP-connection state machine.
+
+One ``ZKConnection`` owns one socket to one ZooKeeper backend and drives
+it through ``init -> connecting -> handshaking -> connected ->
+closing/error -> closed`` (reference: lib/connection-fsm.js:78-351).
+Responsibilities mirror the reference exactly: xid allocation, the
+pending-request table, reply routing, automatic ping keepalive with
+piggybacking, SET_WATCHES queueing, and failing every outstanding
+request exactly once on each teardown path.
+
+Where the reference wires Node streams and sockets together, this uses
+an asyncio ``Protocol`` feeding the symmetric ``PacketCodec``; requests
+are represented by ``ZKRequest`` emitters ('reply'/'error'), which the
+client facade adapts to awaitables.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Callable
+
+from ..protocol import consts
+from ..protocol.errors import ZKError, ZKPingTimeoutError, ZKProtocolError
+from ..protocol.framing import PacketCodec
+from ..utils.events import EventEmitter
+from ..utils.fsm import FSM
+
+log = logging.getLogger('zkstream_tpu.connection')
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    """One ZooKeeper server address (reference: cueball backend objects)."""
+
+    address: str
+    port: int
+
+    @property
+    def key(self) -> str:
+        return '%s:%d' % (self.address, self.port)
+
+
+class ZKRequest(EventEmitter):
+    """One in-flight request: emits 'reply' (packet) or 'error' (exc)
+    exactly once (reference: lib/connection-fsm.js:378-382)."""
+
+    def __init__(self, packet: dict):
+        super().__init__()
+        self.packet = packet
+
+    def as_future(self) -> asyncio.Future:
+        """Adapt to an awaitable resolving to the reply packet."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.once('reply', lambda pkt: fut.done() or fut.set_result(pkt))
+        self.once('error', lambda err, *a: fut.done() or
+                  fut.set_exception(err))
+        return fut
+
+
+class _SocketProtocol(asyncio.Protocol):
+    """Thin adapter: socket callbacks -> connection events."""
+
+    def __init__(self, conn: 'ZKConnection'):
+        self._conn = conn
+
+    def connection_made(self, transport) -> None:
+        self._conn.transport = transport
+        self._conn.emit('sockConnect')
+
+    def data_received(self, data: bytes) -> None:
+        self._conn.emit('sockData', data)
+
+    def eof_received(self) -> bool:
+        self._conn.emit('sockEnd')
+        return True  # keep half-open, like the reference's allowHalfOpen
+
+    def connection_lost(self, exc) -> None:
+        if exc is not None:
+            self._conn.emit('sockError', exc)
+        else:
+            self._conn.emit('sockClose')
+
+
+class ZKConnection(FSM):
+    def __init__(self, client, backend: Backend):
+        #: The owning client; consulted for the session during handshake
+        #: (reference: lib/connection-fsm.js:174).
+        self.client = client
+        self.backend = backend
+        self.codec: PacketCodec | None = None
+        self.transport = None
+        self.session = None
+        self.last_error: Exception | None = None
+        self._xid = 0
+        #: xid -> ZKRequest for everything awaiting a reply
+        #: (reference: zcf_reqs).
+        self.reqs: dict[int, ZKRequest] = {}
+        self._dial_task: asyncio.Task | None = None
+        super().__init__('init')
+
+    # -- public controls (reference: lib/connection-fsm.js:51-76) --
+
+    def connect(self) -> None:
+        assert self.is_in_state('closed') or self.is_in_state('init')
+        self.emit('connectAsserted')
+
+    def close(self) -> None:
+        if self.is_in_state('closed'):
+            return
+        self.emit('closeAsserted')
+
+    def destroy(self) -> None:
+        if self.is_in_state('closed'):
+            return
+        self.emit('destroyAsserted')
+
+    def next_xid(self) -> int:
+        self._xid += 1
+        return self._xid
+
+    # -- states --
+
+    def state_init(self, S) -> None:
+        S.on(self, 'connectAsserted', lambda: S.goto_state('connecting'))
+
+    def state_connecting(self, S) -> None:
+        self.codec = PacketCodec()
+        log.debug('%s: attempting new connection', self.backend.key)
+
+        async def dial():
+            loop = asyncio.get_event_loop()
+            try:
+                await loop.create_connection(
+                    lambda: _SocketProtocol(self),
+                    self.backend.address, self.backend.port)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.emit('sockError', e)
+
+        self._dial_task = asyncio.get_event_loop().create_task(dial())
+
+        S.on(self, 'sockConnect', lambda: S.goto_state('handshaking'))
+
+        def on_error(err):
+            self.last_error = err
+            S.goto_state('error')
+        S.on(self, 'sockError', on_error)
+        S.on(self, 'sockClose', lambda: S.goto_state('closed'))
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closed'))
+        S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
+
+    def state_handshaking(self, S) -> None:
+        def on_data(data):
+            try:
+                pkts = self.codec.decode(data)
+            except ZKProtocolError as e:
+                self.last_error = e
+                S.goto_state('error')
+                return
+            if not pkts:
+                return
+            # Exactly one packet may arrive during the connect phase
+            # (reference: lib/connection-fsm.js:130-140).
+            if len(pkts) > 1:
+                self.last_error = ZKProtocolError('UNEXPECTED_PACKET',
+                    'Received unexpected additional packet during '
+                    'connect phase')
+                S.goto_state('error')
+                return
+            pkt = pkts[0]
+            if pkt['protocolVersion'] != consts.PROTOCOL_VERSION:
+                self.last_error = ZKProtocolError('VERSION_INCOMPAT',
+                    'Server version is not compatible')
+                S.goto_state('error')
+                return
+            self.emit('packet', pkt)
+        S.on(self, 'sockData', on_data)
+
+        def on_error(err):
+            self.last_error = err
+            S.goto_state('error')
+        S.on(self, 'sockError', on_error)
+
+        def on_end():
+            self.last_error = ZKProtocolError('CONNECTION_LOSS',
+                'Connection closed unexpectedly.')
+            S.goto_state('error')
+        S.on(self, 'sockEnd', on_end)
+        S.on(self, 'sockClose', on_end)
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closed'))
+        S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
+
+        self.session = self.client.get_session()
+        if self.session is None:
+            S.goto_state('closed')
+            return
+
+        # Guard against a session already attaching to another connection
+        # (reference: lib/connection-fsm.js:180-187, the nasty.test.js
+        # monitor-mode race).
+        if self.session.is_attaching():
+            log.debug('%s: session in state %s while handshaking',
+                      self.backend.key, self.session.get_state())
+            self.last_error = ZKProtocolError('ATTACH_RACE',
+                'ZKSession attaching to another connection')
+            S.goto_state('error')
+            return
+
+        def on_session_state(st):
+            if st == 'attached':
+                S.goto_state('connected')
+        S.on(self.session, 'stateChanged', on_session_state)
+
+        self.session.attach_and_send_cr(self)
+
+    def state_connected(self, S) -> None:
+        # Handshake is over: steady-state request/reply framing from here
+        # (the reference flips this per-frame via isInState checks).
+        self.codec.handshaking = False
+
+        ping_interval = max(self.session.get_timeout() / 4, 2000)
+        S.interval(ping_interval, self.ping)
+
+        def on_data(data):
+            err = None
+            try:
+                pkts = self.codec.decode(data)
+            except ZKProtocolError as e:
+                # Deliver packets decoded before the bad frame first.
+                pkts = getattr(e, 'packets', [])
+                err = e
+            for pkt in pkts:
+                self.emit('packet', pkt)
+                # Notifications are the session's business
+                # (reference: lib/connection-fsm.js:223-224).
+                if pkt['opcode'] != 'NOTIFICATION':
+                    self.process_reply(pkt)
+            if err is not None:
+                self.last_error = err
+                S.goto_state('error')
+        S.on(self, 'sockData', on_data)
+
+        def on_error(err):
+            self.last_error = err
+            S.goto_state('error')
+        S.on(self, 'sockError', on_error)
+
+        def on_end():
+            self.last_error = ZKProtocolError('CONNECTION_LOSS',
+                'Connection closed unexpectedly.')
+            S.goto_state('error')
+        S.on(self, 'sockEnd', on_end)
+        S.on(self, 'sockClose', on_end)
+
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closing'))
+        S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
+
+        def on_ping_timeout():
+            self.last_error = ZKPingTimeoutError()
+            S.goto_state('error')
+        S.on(self, 'pingTimeout', on_ping_timeout)
+
+        S.immediate(lambda: self.emit('connect'))
+
+    def state_closing(self, S) -> None:
+        """Drain outstanding requests, then send CLOSE_SESSION and wait
+        for its reply (reference: lib/connection-fsm.js:263-307)."""
+        close_xid: list[int | None] = [None]
+
+        def send_close_session():
+            if close_xid[0] is not None:
+                return
+            close_xid[0] = self.next_xid()
+            log.info('%s: sent CLOSE_SESSION request (xid %d)',
+                     self.backend.key, close_xid[0])
+            self._write({'opcode': 'CLOSE_SESSION', 'xid': close_xid[0]})
+            try:
+                if self.transport and self.transport.can_write_eof():
+                    self.transport.write_eof()
+            except (OSError, RuntimeError):
+                pass
+
+        def on_data(data):
+            try:
+                pkts = self.codec.decode(data)
+            except ZKProtocolError as e:
+                self.last_error = e
+                S.goto_state('closed')
+                return
+            for pkt in pkts:
+                if pkt['xid'] == close_xid[0]:
+                    S.goto_state('closed')
+                    return
+                self.process_reply(pkt)
+                if not self.reqs:
+                    send_close_session()
+        S.on(self, 'sockData', on_data)
+
+        def on_error(err):
+            self.last_error = err
+            S.goto_state('closed')
+        S.on(self, 'sockError', on_error)
+        S.on(self, 'sockEnd', lambda: S.goto_state('closed'))
+        S.on(self, 'sockClose', lambda: S.goto_state('closed'))
+        S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
+
+        if not self.reqs:
+            send_close_session()
+
+    def state_error(self, S) -> None:
+        log.warning('%s: error communicating with ZK: %s',
+                    self.backend.key, self.last_error)
+        reqs, self.reqs = self.reqs, {}
+        for req in reqs.values():
+            req.emit('error', self.last_error)
+
+        # Deliberately not scope-bound: the 'error' event must fire even
+        # though we leave this state immediately
+        # (reference: lib/connection-fsm.js:317-323).
+        err = self.last_error
+        asyncio.get_event_loop().call_soon(lambda: self.emit('error', err))
+
+        S.goto_state('closed')
+
+    def state_closed(self, S) -> None:
+        if self._dial_task is not None and not self._dial_task.done():
+            self._dial_task.cancel()
+        self._dial_task = None
+        if self.transport is not None:
+            try:
+                self.transport.abort()
+            except (OSError, RuntimeError):
+                pass
+        self.transport = None
+
+        S.on(self, 'connectAsserted', lambda: S.goto_state('connecting'))
+
+        def fail_stragglers():
+            self.emit('close')
+            # Fail any remaining outstanding requests or they would hang
+            # forever (reference: lib/connection-fsm.js:338-350).
+            err = ZKProtocolError('CONNECTION_LOSS', 'Connection closed.')
+            reqs, self.reqs = self.reqs, {}
+            for req in reqs.values():
+                req.emit('error', err)
+        S.immediate(fail_stragglers)
+
+    # -- request plumbing --
+
+    def _write(self, pkt: dict) -> None:
+        data = self.codec.encode(pkt)
+        if self.transport is not None:
+            self.transport.write(data)
+
+    def process_reply(self, pkt: dict) -> None:
+        """Route a reply to its pending request
+        (reference: lib/connection-fsm.js:353-376)."""
+        req = self.reqs.get(pkt['xid'])
+        log.debug('%s: server replied to xid %d err %s',
+                  self.backend.key, pkt['xid'], pkt['err'])
+        if req is None:
+            return
+        if pkt['err'] == 'OK':
+            req.emit('reply', pkt)
+        else:
+            req.emit('error', ZKError(pkt['err']), pkt)
+
+    def request(self, pkt: dict) -> ZKRequest:
+        """Send a normal (positive-xid) request
+        (reference: lib/connection-fsm.js:384-408)."""
+        if not self.is_in_state('connected'):
+            raise ZKProtocolError('CONNECTION_LOSS',
+                'Client must be connected to send requests')
+        req = ZKRequest(pkt)
+        pkt['xid'] = self.next_xid()
+        self.reqs[pkt['xid']] = req
+
+        def end_request(*args):
+            self.reqs.pop(pkt['xid'], None)
+        req.once('reply', end_request)
+        req.once('error', end_request)
+
+        log.debug('%s: sent request xid %d opcode %s',
+                  self.backend.key, pkt['xid'], pkt['opcode'])
+        self._write(pkt)
+        return req
+
+    def send(self, pkt: dict) -> None:
+        """Raw send, used by the session for ConnectRequests
+        (reference: lib/connection-fsm.js:410-413)."""
+        self._write(pkt)
+
+    def ping(self, cb: Callable | None = None) -> None:
+        """Keep-alive ping on the reserved xid; concurrent pings
+        piggyback on the in-flight one
+        (reference: lib/connection-fsm.js:415-463)."""
+        if not self.is_in_state('connected'):
+            raise ZKProtocolError('CONNECTION_LOSS',
+                'Client must be connected to send packets')
+        pkt = {'xid': consts.XID_PING, 'opcode': 'PING'}
+        existing = self.reqs.get(consts.XID_PING)
+        if existing is not None:
+            if cb:
+                existing.once('reply', lambda _pkt: cb(None, None))
+                existing.once('error', lambda err, *a: cb(err, None))
+            return
+        req = ZKRequest(pkt)
+        self.reqs[consts.XID_PING] = req
+        timeout_ms = max(self.session.get_timeout() / 8, 2000)
+        loop = asyncio.get_event_loop()
+        t1 = time.monotonic()
+
+        def on_reply(rpkt):
+            self.reqs.pop(consts.XID_PING, None)
+            timer.cancel()
+            latency = (time.monotonic() - t1) * 1000.0
+            log.debug('%s: ping ok in %d ms', self.backend.key, latency)
+            if cb:
+                cb(None, latency)
+
+        def on_error(err, *args):
+            self.reqs.pop(consts.XID_PING, None)
+            timer.cancel()
+            if cb:
+                cb(err, None)
+
+        def on_timeout():
+            req.remove_listener('reply', on_reply)
+            self.emit('pingTimeout')
+
+        req.once('reply', on_reply)
+        req.once('error', on_error)
+        timer = loop.call_later(timeout_ms / 1000.0, on_timeout)
+        self._write(pkt)
+
+    def set_watches(self, events: dict, rel_zxid: int,
+                    cb: Callable) -> None:
+        """Send SET_WATCHES on its reserved xid; a second call while one
+        is in flight queues behind it
+        (reference: lib/connection-fsm.js:465-499)."""
+        if not self.is_in_state('connected'):
+            raise ZKProtocolError('CONNECTION_LOSS',
+                'Client must be connected to send packets (is in state %s)'
+                % (self.get_state(),))
+        pkt = {'xid': consts.XID_SET_WATCHES, 'opcode': 'SET_WATCHES',
+               'relZxid': rel_zxid, 'events': events}
+        existing = self.reqs.get(consts.XID_SET_WATCHES)
+        if existing is not None:
+            existing.once('reply',
+                lambda _pkt: self.set_watches(events, rel_zxid, cb))
+            existing.once('error', lambda err, *a: cb(err))
+            return
+        req = ZKRequest(pkt)
+        self.reqs[consts.XID_SET_WATCHES] = req
+
+        def on_reply(rpkt):
+            self.reqs.pop(consts.XID_SET_WATCHES, None)
+            cb(None)
+
+        def on_error(err, *args):
+            self.reqs.pop(consts.XID_SET_WATCHES, None)
+            cb(err)
+
+        req.once('reply', on_reply)
+        req.once('error', on_error)
+        self._write(pkt)

@@ -1,0 +1,303 @@
+"""Backend-set management: the rebuild's replacement for cueball.
+
+The reference delegates multi-server handling to the cueball library: a
+static resolver over the ``servers[]`` list, a ConnectionSet holding one
+live connection (target 1, max 3), a retry/backoff recovery policy, and
+periodic "decoherence" rebalancing toward more-preferred backends
+(reference: lib/client.js:88-118).  There is no Python cueball, so this
+module implements the same observable behavior directly:
+
+- dial backends in preference order (optionally shuffled, seeded);
+- per-attempt connect timeout + retry/delay policy matching the
+  reference's recovery numbers (connect: 3000 ms x 3, 500 ms delay;
+  default: 5000 ms x 3, 1000 ms delay);
+- emit ``failed`` once when the initial retry policy exhausts on every
+  backend, then keep dialing in monitor mode (cueball's failed state);
+- when connected to a less-preferred backend, periodically try to move
+  to a more-preferred one (decoherence; the live-session migration
+  itself is the session's ``reattaching`` state, which reverts on
+  failure);
+- events: ``added(key, conn)``, ``removed(key, conn)``,
+  ``stateChanged(state)`` with states starting/running/failed/stopped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import random
+
+from ..utils.events import EventEmitter
+from .connection import Backend, ZKConnection
+
+log = logging.getLogger('zkstream_tpu.pool')
+
+
+@dataclasses.dataclass
+class RecoveryPolicy:
+    """Connect retry policy (reference: lib/client.js:96-107)."""
+
+    timeout: int = 5000
+    retries: int = 3
+    delay: int = 1000
+
+
+DEFAULT_CONNECT_POLICY = RecoveryPolicy(timeout=3000, retries=3, delay=500)
+DEFAULT_POLICY = RecoveryPolicy(timeout=5000, retries=3, delay=1000)
+
+#: How often to try moving back to a more-preferred backend, ms
+#: (reference: decoherenceInterval 600 s, lib/client.js:110-111).
+DEFAULT_DECOHERENCE_INTERVAL = 600 * 1000
+
+
+class ConnectionPool(EventEmitter):
+    def __init__(self, client, backends: list[Backend],
+                 connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
+                 default_policy: RecoveryPolicy = DEFAULT_POLICY,
+                 decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
+                 shuffle: bool = True, seed: int | None = None):
+        super().__init__()
+        assert backends, 'at least one backend required'
+        self._client = client
+        self._backends = list(backends)
+        if shuffle:
+            random.Random(seed).shuffle(self._backends)
+        self._connect_policy = connect_policy
+        self._default_policy = default_policy
+        self._decoherence_interval = decoherence_interval
+
+        self.state = 'stopped'
+        self.conn: ZKConnection | None = None
+        self._conn_index: int | None = None
+        #: Resolved when the pool's *current* connection dies; the dial
+        #: loop parks on it while a connection is live.
+        self._hold: asyncio.Future | None = None
+        self._task: asyncio.Task | None = None
+        self._decoherence_handle: asyncio.TimerHandle | None = None
+        self._decoherence_task: asyncio.Task | None = None
+        #: True while _try_rebalance is mid-flight: the old connection's
+        #: death is then expected (the session migration destroys it)
+        #: and must not wake the dial loop.
+        self._rebalancing = False
+        self._stopping = False
+        self._failed_emitted = False
+
+    @property
+    def backends(self) -> list[Backend]:
+        return list(self._backends)
+
+    def current_backend(self) -> Backend | None:
+        return self.conn.backend if self.conn is not None else None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        assert self._task is None, 'pool already started'
+        self._stopping = False
+        self._set_state('starting')
+        self._task = asyncio.get_event_loop().create_task(self._dial_loop())
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._cancel_decoherence()
+        if self._decoherence_task is not None:
+            self._decoherence_task.cancel()
+            self._decoherence_task = None
+        self._drop_conn(destroy=True)
+        self._set_state('stopped')
+
+    def _set_state(self, st: str) -> None:
+        if self.state != st:
+            self.state = st
+            self.emit('stateChanged', st)
+
+    # -- current-connection bookkeeping --
+
+    def _install_conn(self, idx: int, conn: ZKConnection) -> None:
+        self.conn = conn
+        self._conn_index = idx
+        self.emit('added', conn.backend.key, conn)
+
+        def on_dead(*args):
+            # Only react if this is still the pool's current connection
+            # (after a rebalance swap the old conn dies later, already
+            # dropped from our bookkeeping).
+            if self.conn is conn:
+                self._drop_conn(destroy=True)
+                # During a rebalance the old connection's death is the
+                # session migration destroying it; the rebalance task
+                # owns the hold future's fate then.
+                if self._rebalancing:
+                    return
+                if self._hold is not None and not self._hold.done():
+                    self._hold.set_result(None)
+        conn.on('error', on_dead)
+        conn.on('close', on_dead)
+        if not (conn.is_in_state('connected') or
+                conn.is_in_state('closing')):
+            on_dead()
+
+    def _drop_conn(self, destroy: bool) -> None:
+        if self.conn is None:
+            return
+        conn, self.conn = self.conn, None
+        self._conn_index = None
+        self.emit('removed', conn.backend.key, conn)
+        if destroy:
+            conn.destroy()
+
+    # -- dialing --
+
+    async def _dial_one(self, backend: Backend,
+                        timeout_ms: int) -> ZKConnection | None:
+        """Dial one backend; resolve to the connection if it reaches
+        'connected' within the timeout, else None."""
+        conn = ZKConnection(self._client, backend)
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def settle(*args):
+            if not fut.done():
+                fut.set_result(None)
+        conn.on('connect', settle)
+        conn.on('error', settle)
+        conn.on('close', settle)
+        conn.connect()
+        try:
+            await asyncio.wait_for(asyncio.shield(fut),
+                                   timeout_ms / 1000.0)
+        except asyncio.TimeoutError:
+            pass
+        except asyncio.CancelledError:
+            conn.destroy()
+            raise
+        finally:
+            conn.remove_listener('connect', settle)
+            conn.remove_listener('error', settle)
+            conn.remove_listener('close', settle)
+        if conn.is_in_state('connected'):
+            return conn
+        conn.destroy()
+        return None
+
+    async def _dial_loop(self) -> None:
+        """Keep one live connection.  The initial phase uses the connect
+        policy; once it exhausts on all backends, emit 'failed' and keep
+        dialing under the default policy (cueball monitor mode)."""
+        policy = self._connect_policy
+        while not self._stopping:
+            connected = False
+            for attempt in range(policy.retries):
+                for idx, backend in enumerate(self._backends):
+                    if self._stopping:
+                        return
+                    conn = await self._dial_one(backend, policy.timeout)
+                    if conn is None:
+                        continue
+                    self._failed_emitted = False
+                    connected = True
+                    await self._hold_connection(idx, conn)
+                    break
+                if connected:
+                    break
+                if attempt + 1 < policy.retries:
+                    await asyncio.sleep(policy.delay / 1000.0)
+            if connected:
+                # The connection (or its successor) died; dial again
+                # under the fresh-connect policy.
+                policy = self._connect_policy
+                continue
+            if not self._failed_emitted:
+                self._failed_emitted = True
+                self._set_state('failed')
+                log.warning('failed to connect to any ZK backend '
+                            '(exhausted retry policy); entering monitor '
+                            'mode')
+            policy = self._default_policy
+            await asyncio.sleep(policy.delay / 1000.0)
+
+    async def _hold_connection(self, idx: int, conn: ZKConnection) -> None:
+        """Park while a connection (or a rebalance successor) is live."""
+        loop = asyncio.get_event_loop()
+        self._hold = loop.create_future()
+        self._install_conn(idx, conn)
+        self._set_state('running')
+        if idx > 0:
+            self._arm_decoherence()
+        try:
+            await self._hold
+        finally:
+            self._hold = None
+            self._cancel_decoherence()
+
+    # -- decoherence: move toward preferred backends --
+
+    def _arm_decoherence(self) -> None:
+        self._cancel_decoherence()
+        loop = asyncio.get_event_loop()
+
+        def fire():
+            if self._decoherence_task is None or \
+               self._decoherence_task.done():
+                self._decoherence_task = loop.create_task(
+                    self._try_rebalance())
+        self._decoherence_handle = loop.call_later(
+            self._decoherence_interval / 1000.0, fire)
+
+    def _cancel_decoherence(self) -> None:
+        if self._decoherence_handle is not None:
+            self._decoherence_handle.cancel()
+            self._decoherence_handle = None
+
+    async def _try_rebalance(self) -> None:
+        """Dial more-preferred backends; a successful handshake makes
+        the session migrate (its 'reattaching' state handles revert on
+        failure).  On success, swap the pool's current connection; the
+        old one is destroyed by the session once the new one connects —
+        an expected death that must not wake the dial loop (it would
+        dial a redundant connection and force another migration)."""
+        cur = self._conn_index
+        if cur is None or cur == 0 or self.conn is None:
+            return
+        self._rebalancing = True
+        try:
+            for idx in range(cur):
+                if self._stopping:
+                    return
+                backend = self._backends[idx]
+                log.debug('decoherence: trying preferred backend %s',
+                          backend.key)
+                conn = await self._dial_one(backend,
+                                            self._connect_policy.timeout)
+                if self._stopping:
+                    if conn is not None:
+                        conn.destroy()
+                    return
+                if conn is not None:
+                    old = self.conn
+                    # Drop the old conn from bookkeeping without
+                    # destroying it: the session owns its teardown
+                    # after migration (it may already be dead and
+                    # dropped by its death watch).
+                    self.conn = None
+                    self._conn_index = None
+                    if old is not None:
+                        self.emit('removed', old.backend.key, old)
+                    self._install_conn(idx, conn)
+                    if idx > 0:
+                        self._arm_decoherence()
+                    return
+        finally:
+            self._rebalancing = False
+            # If every attempt failed AND the old connection died while
+            # we were trying (its death watch deferred to us), wake the
+            # dial loop now.
+            if self.conn is None and self._hold is not None and \
+               not self._hold.done():
+                self._hold.set_result(None)
+        if self._conn_index is not None and self._conn_index > 0:
+            self._arm_decoherence()

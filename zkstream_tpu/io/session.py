@@ -1,0 +1,367 @@
+"""The durable session layer.
+
+A ``ZKSession`` outlives any one TCP connection: it holds the protocol
+state that makes a session resumable — sessionId, password, and the last
+zxid seen — and attaches to whichever ``ZKConnection`` is currently live,
+re-sending those credentials in the ConnectRequest so the server resumes
+rather than recreates the session (reference: lib/zk-session.js:38-480).
+That triple *is* the checkpoint/resume mechanism of this system; nothing
+touches disk.
+
+States: ``detached / attaching / attached / reattaching / closing /
+expired / closed``.  ``reattaching`` implements live-session migration to
+a more-preferred backend with revert-on-failure
+(reference: lib/zk-session.js:265-339).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..protocol import consts
+from ..utils.events import EventEmitter
+from ..utils.fsm import FSM
+from ..utils.metrics import Collector
+from .watcher import ZKWatcher
+
+log = logging.getLogger('zkstream_tpu.session')
+
+METRIC_ZK_NOTIFICATION_COUNTER = 'zookeeper_notifications'
+
+#: NOTIFICATION wire type -> user-facing watcher event name.
+_NOTIFICATION_EVENTS = {
+    'CREATED': 'created',
+    'DELETED': 'deleted',
+    'DATA_CHANGED': 'dataChanged',
+    'CHILDREN_CHANGED': 'childrenChanged',
+}
+
+
+class ZKSession(FSM):
+    def __init__(self, timeout: int, collector: Collector | None = None):
+        self.conn = None
+        self.old_conn = None
+        #: Wall-clock ms of the last packet; liveness = a packet within
+        #: the session timeout (reference: lib/zk-session.js:77-87).
+        self.last_pkt: float | None = None
+        self.expiry_timer = EventEmitter()
+        self._expiry_handle: asyncio.TimerHandle | None = None
+        self.watchers: dict[str, ZKWatcher] = {}
+        self.timeout = timeout
+        self.last_attach = 0.0
+        self.collector = collector if collector is not None else Collector()
+        self.collector.counter(METRIC_ZK_NOTIFICATION_COUNTER,
+            'Notifications received from ZooKeeper')
+
+        #: The session triple that makes resumption possible
+        #: (reference: lib/zk-session.js:57-59).
+        self.last_zxid = 0
+        self.session_id = 0
+        self.passwd = b'\x00' * 16
+
+        super().__init__('detached')
+
+    # -- public accessors --
+
+    def is_attaching(self) -> bool:
+        return (self.is_in_state('attaching') or
+                self.is_in_state('reattaching'))
+
+    def is_alive(self) -> bool:
+        if self.last_pkt is None:
+            return False
+        delta = time.monotonic() * 1000.0 - self.last_pkt
+        return delta < self.timeout
+
+    def attach_and_send_cr(self, conn) -> None:
+        """Called by a connection mid-handshake to bind this session to
+        it (reference: lib/zk-session.js:89-97)."""
+        if not self.is_in_state('detached') and \
+           not self.is_in_state('attached'):
+            raise RuntimeError('ZKSession.attach_and_send_cr may only be '
+                'called in state "attached" or "detached" (is in %s)'
+                % (self.get_state(),))
+        self.emit('assertAttach', conn)
+
+    def reset_expiry_timer(self) -> None:
+        self.last_pkt = time.monotonic() * 1000.0
+        if self._expiry_handle is not None:
+            self._expiry_handle.cancel()
+        loop = asyncio.get_event_loop()
+
+        def fire():
+            self._expiry_handle = None
+            self.expiry_timer.emit('timeout')
+        self._expiry_handle = loop.call_later(self.timeout / 1000.0, fire)
+
+    def _cancel_expiry_timer(self) -> None:
+        if self._expiry_handle is not None:
+            self._expiry_handle.cancel()
+            self._expiry_handle = None
+
+    def get_timeout(self) -> int:
+        return self.timeout
+
+    def get_connection(self):
+        if not self.is_in_state('attached'):
+            return None
+        return self.conn
+
+    def get_session_id(self) -> str:
+        return '%016x' % (self.session_id,)
+
+    def close(self) -> None:
+        self.emit('closeAsserted')
+
+    # -- states --
+
+    def state_detached(self, S) -> None:
+        if self.conn is not None:
+            self.conn.destroy()
+        self.conn = None
+
+        def on_attach(conn):
+            self.conn = conn
+            S.goto_state('attaching')
+        S.on(self, 'assertAttach', on_attach)
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closed'))
+        S.on(self.expiry_timer, 'timeout', lambda: S.goto_state('expired'))
+        self.watchers_disconnected()
+
+    def state_attaching(self, S) -> None:
+        def on_conn_dead(*args):
+            # The connect attempt died.  A live session keeps trying; a
+            # session that had an id and ran out the clock is expired
+            # (reference: lib/zk-session.js:150-159).
+            if self.is_alive():
+                S.goto_state('detached')
+            elif self.session_id != 0:
+                S.goto_state('expired')
+            else:
+                S.goto_state('detached')
+        S.on(self.conn, 'error', on_conn_dead)
+        S.on(self.conn, 'close', on_conn_dead)
+
+        def on_packet(pkt):
+            if pkt['sessionId'] == 0:
+                # The server zeroed the id: our session is gone
+                # (reference: lib/zk-session.js:170-173).
+                S.goto_state('expired')
+                return
+            verb = 'resumed' if self.session_id != 0 else 'created'
+            log.info('%s zookeeper session %016x with timeout %d ms',
+                     verb, pkt['sessionId'], pkt['timeOut'])
+            self.timeout = pkt['timeOut']
+            self.session_id = pkt['sessionId']
+            self.passwd = pkt['passwd']
+            self.reset_expiry_timer()
+            S.goto_state('attached')
+        S.on(self.conn, 'packet', on_packet)
+
+        S.on(self.expiry_timer, 'timeout', lambda: S.goto_state('expired'))
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closing'))
+
+        self.conn.send({
+            'protocolVersion': consts.PROTOCOL_VERSION,
+            'lastZxidSeen': self.last_zxid,
+            'timeOut': self.timeout,
+            'sessionId': self.session_id,
+            'passwd': self.passwd,
+        })
+
+    def state_attached(self, S) -> None:
+        self.last_attach = time.monotonic()
+
+        def on_conn_dead(*args):
+            if self.is_alive():
+                S.goto_state('detached')
+            else:
+                S.goto_state('expired')
+        S.on(self.conn, 'close', on_conn_dead)
+        S.on(self.conn, 'error', on_conn_dead)
+
+        def on_packet(pkt):
+            self.reset_expiry_timer()
+            if pkt['opcode'] != 'NOTIFICATION':
+                # Track the max zxid seen: it anchors both session
+                # resumption and watch catch-up
+                # (reference: lib/zk-session.js:229-235).
+                if pkt['zxid'] > self.last_zxid:
+                    self.last_zxid = pkt['zxid']
+                return
+            self.process_notification(pkt)
+        S.on(self.conn, 'packet', on_packet)
+
+        S.on(self.expiry_timer, 'timeout', lambda: S.goto_state('expired'))
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closing'))
+
+        def on_conn_state(st):
+            if st == 'connected':
+                if self.old_conn is not None:
+                    self.old_conn.destroy()
+                    self.old_conn = None
+                self.resume_watches()
+        S.on(self.conn, 'stateChanged', on_conn_state)
+
+        def on_attach(conn):
+            self.old_conn = self.conn
+            self.conn = conn
+            S.goto_state('reattaching')
+        S.on(self, 'assertAttach', on_attach)
+
+    def state_reattaching(self, S) -> None:
+        """Move a live session to a more-preferred backend, reverting to
+        the old connection on failure (reference:
+        lib/zk-session.js:265-339)."""
+        assert self.old_conn is not None, 'reattaching requires old_conn'
+
+        def on_packet(pkt):
+            if pkt['sessionId'] == 0:
+                revert()
+                return
+            log.info('moved zookeeper session %016x to more preferred '
+                     'backend (%s) with timeout %d ms', pkt['sessionId'],
+                     self.conn.backend.key, pkt['timeOut'])
+            self.timeout = pkt['timeOut']
+            self.session_id = pkt['sessionId']
+            self.passwd = pkt['passwd']
+            self.reset_expiry_timer()
+            self.watchers_disconnected()
+            S.goto_state('attached')
+        S.on(self.conn, 'packet', on_packet)
+
+        def revert(*args):
+            if self.is_alive() and self.old_conn.is_in_state('connected'):
+                log.warning('reverted move of session %016x to new '
+                            'backend (%s)', self.session_id,
+                            self.conn.backend.key)
+                self.conn = self.old_conn
+                self.old_conn = None
+                S.goto_state('attached')
+            elif self.is_alive():
+                self.old_conn.destroy()
+                self.old_conn = None
+                S.goto_state('detached')
+            else:
+                self.old_conn.close()
+                self.old_conn = None
+                S.goto_state('expired')
+        S.on(self.conn, 'error', revert)
+        S.on(self.conn, 'close', revert)
+        S.on(self.expiry_timer, 'timeout', revert)
+
+        def on_close_asserted():
+            self.old_conn.close()
+            self.old_conn = None
+            S.goto_state('closing')
+        S.on(self, 'closeAsserted', on_close_asserted)
+
+        log.debug('attempting to move zookeeper session %016x from %s '
+                  'to %s', self.session_id, self.old_conn.backend.key,
+                  self.conn.backend.key)
+
+        self.conn.send({
+            'protocolVersion': consts.PROTOCOL_VERSION,
+            'lastZxidSeen': self.last_zxid,
+            'timeOut': self.timeout,
+            'sessionId': self.session_id,
+            'passwd': self.passwd,
+        })
+
+    def state_closing(self, S) -> None:
+        S.on(self.conn, 'error', lambda *a: S.goto_state('closed'))
+        S.on(self.conn, 'close', lambda: S.goto_state('closed'))
+        S.on(self.expiry_timer, 'timeout', lambda: S.goto_state('closed'))
+        self.conn.close()
+
+    def state_expired(self, S) -> None:
+        if self.conn is not None:
+            self.conn.destroy()
+        self.conn = None
+        self._cancel_expiry_timer()
+        log.warning('ZK session expired')
+
+    def state_closed(self, S) -> None:
+        if self.conn is not None:
+            self.conn.destroy()
+        self.conn = None
+        self._cancel_expiry_timer()
+        log.info('ZK session closed')
+
+    # -- watcher plumbing --
+
+    def watchers_disconnected(self) -> None:
+        """Tell every armed watch event it is on the auto-resume list
+        (reference: lib/zk-session.js:377-387)."""
+        for w in list(self.watchers.values()):
+            for event in w.events():
+                event.disconnected()
+
+    def process_notification(self, pkt: dict) -> None:
+        """Dispatch a NOTIFICATION to the right path's watcher
+        (reference: lib/zk-session.js:389-419)."""
+        if pkt['state'] != 'SYNC_CONNECTED':
+            log.warning('received notification with bad state %s',
+                        pkt['state'])
+            return
+        evt = _NOTIFICATION_EVENTS[pkt['type']]
+        log.debug('notification %s for %s', evt, pkt['path'])
+        self.collector.get_collector(
+            METRIC_ZK_NOTIFICATION_COUNTER).increment({'event': evt})
+        watcher = self.watchers.get(pkt['path'])
+        if watcher is not None:
+            watcher.notify(evt)
+
+    def resume_watches(self) -> None:
+        """After reconnect, batch every watch event in 'resuming' into
+        one SET_WATCHES anchored at the last zxid seen, then release them
+        (reference: lib/zk-session.js:421-471)."""
+        events = {'dataChanged': [], 'createdOrDestroyed': [],
+                  'childrenChanged': []}
+        all_evts = []
+        count = 0
+        for path, w in self.watchers.items():
+            cod = False
+            for event in w.events():
+                if not event.is_in_state('resuming'):
+                    continue
+                evt = event.get_event()
+                if evt == 'createdOrDeleted':
+                    if cod:
+                        continue
+                    events['createdOrDestroyed'].append(path)
+                    count += 1
+                    cod = True
+                elif evt == 'dataChanged':
+                    events['dataChanged'].append(path)
+                    count += 1
+                elif evt == 'childrenChanged':
+                    events['childrenChanged'].append(path)
+                    count += 1
+                else:
+                    raise AssertionError('unknown event: %s' % (evt,))
+                all_evts.append(event)
+        if count < 1:
+            return
+        zxid = self.last_zxid
+        log.info('re-arming %d node watchers at zxid %x', count, zxid)
+
+        def done(err):
+            if err is not None:
+                log.warning('SET_WATCHES failed during watch resumption: '
+                            '%s', err)
+                return
+            for event in all_evts:
+                event.resume()
+        self.conn.set_watches(events, zxid, done)
+
+    def watcher(self, path: str) -> ZKWatcher:
+        """One cached ZKWatcher per path
+        (reference: lib/zk-session.js:473-480)."""
+        w = self.watchers.get(path)
+        if w is None:
+            w = ZKWatcher(self, path)
+            self.watchers[path] = w
+        return w

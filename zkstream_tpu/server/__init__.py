@@ -1,0 +1,11 @@
+"""In-process ZooKeeper server: data model, wire server, and ensemble
+simulation (the rebuild's replacement for the reference's JVM-spawning
+test harness, test/zkserver.js)."""
+
+from .server import ServerConnection, ZKEnsemble, ZKServer  # noqa: F401
+from .store import (  # noqa: F401
+    ZKDatabase,
+    ZKOpError,
+    ZKServerSession,
+    Znode,
+)

@@ -1,0 +1,374 @@
+"""The in-process asyncio ZooKeeper server.
+
+Speaks the same wire protocol as the client through the symmetric
+``PacketCodec(server=True)`` — the capability the reference's stream
+codec advertises for building fake test servers
+(reference: lib/zk-streams.js:28,70-71,84-85) but cannot actually
+deliver (its reply encoder is missing).  This one is complete enough to
+run the whole client test suite against: handshake with session
+create/resume, the full request set, one-shot server-side watches with
+correct locality, SET_WATCHES catch-up by relZxid, and session
+migration between ensemble members.
+
+``ZKEnsemble`` runs N servers over one shared ``ZKDatabase`` to simulate
+a quorum on localhost (see store.py for why that is faithful enough for
+the client-visible semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..protocol.consts import XID_NOTIFICATION, CreateFlag
+from ..protocol.errors import ZKProtocolError
+from ..protocol.framing import PacketCodec
+from .store import ZKDatabase, ZKOpError, ZKServerSession, parent_path
+
+log = logging.getLogger('zkstream_tpu.server')
+
+
+class ServerConnection:
+    """One accepted client socket: handshake, request dispatch, and this
+    connection's watch tables."""
+
+    def __init__(self, server: 'ZKServer', reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.db = server.db
+        self.reader = reader
+        self.writer = writer
+        self.codec = PacketCodec(server=True)
+        self.session: ZKServerSession | None = None
+        #: One-shot watch tables, local to this connection (they die
+        #: with the server, exactly like real ZK's).
+        self.data_watches: dict[str, bool] = {}
+        self.child_watches: dict[str, bool] = {}
+        self.closed = False
+        self._subscribed = False
+
+    # -- wire helpers --
+
+    def _send(self, pkt: dict) -> None:
+        if self.closed:
+            return
+        try:
+            self.writer.write(self.codec.encode(pkt))
+        except (ConnectionError, RuntimeError):
+            pass
+
+    def _reply(self, xid: int, opcode: str, err: str = 'OK',
+               **body) -> None:
+        pkt = {'xid': xid, 'zxid': self.db.zxid, 'err': err,
+               'opcode': opcode}
+        pkt.update(body)
+        self._send(pkt)
+
+    def notify(self, ntype: str, path: str) -> None:
+        self._send({'xid': XID_NOTIFICATION, 'zxid': self.db.zxid,
+                    'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
+                    'state': 'SYNC_CONNECTED', 'path': path})
+
+    # -- watch dispatch (db change events -> this connection) --
+
+    def _subscribe(self) -> None:
+        if self._subscribed:
+            return
+        self._subscribed = True
+        self.db.on('created', self._on_created)
+        self.db.on('deleted', self._on_deleted)
+        self.db.on('dataChanged', self._on_data_changed)
+        self.db.on('childrenChanged', self._on_children_changed)
+        self.db.on('sessionExpired', self._on_session_expired)
+
+    def _unsubscribe(self) -> None:
+        if not self._subscribed:
+            return
+        self._subscribed = False
+        self.db.remove_listener('created', self._on_created)
+        self.db.remove_listener('deleted', self._on_deleted)
+        self.db.remove_listener('dataChanged', self._on_data_changed)
+        self.db.remove_listener('childrenChanged',
+                                self._on_children_changed)
+        self.db.remove_listener('sessionExpired', self._on_session_expired)
+
+    def _on_created(self, path: str, zxid: int) -> None:
+        if self.data_watches.pop(path, None):
+            self.notify('CREATED', path)
+
+    def _on_deleted(self, path: str, zxid: int) -> None:
+        if self.data_watches.pop(path, None):
+            self.notify('DELETED', path)
+        if self.child_watches.pop(path, None):
+            self.notify('DELETED', path)
+
+    def _on_data_changed(self, path: str, zxid: int) -> None:
+        if self.data_watches.pop(path, None):
+            self.notify('DATA_CHANGED', path)
+
+    def _on_children_changed(self, path: str, zxid: int) -> None:
+        if self.child_watches.pop(path, None):
+            self.notify('CHILDREN_CHANGED', path)
+
+    def _on_session_expired(self, session_id: int) -> None:
+        if self.session is not None and self.session.id == session_id:
+            self.close()
+
+    # -- lifecycle --
+
+    async def run(self) -> None:
+        try:
+            while not self.closed:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                try:
+                    pkts = self.codec.decode(data)
+                except ZKProtocolError as e:
+                    log.debug('server: undecodable input: %s', e)
+                    break
+                for pkt in pkts:
+                    if self.codec.handshaking:
+                        self._handle_connect(pkt)
+                    else:
+                        self._handle_request(pkt)
+                    if self.closed:
+                        break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._unsubscribe()
+        if self.session is not None and self.session.owner is self:
+            self.session.owner = None
+        self.server.conns.discard(self)
+        try:
+            self.writer.close()
+        except (ConnectionError, RuntimeError):
+            pass
+
+    # -- handshake (session create / resume / migrate) --
+
+    def _handle_connect(self, pkt: dict) -> None:
+        timeout = pkt['timeOut']
+        if pkt['sessionId'] == 0:
+            sess = self.db.create_session(timeout)
+        else:
+            sess = self.db.resume_session(pkt['sessionId'], pkt['passwd'])
+            if sess is None:
+                # Unknown/expired session: zero id tells the client its
+                # session is gone.
+                self._send({'protocolVersion': 0, 'timeOut': timeout,
+                            'sessionId': 0, 'passwd': b'\x00' * 16})
+                self.codec.handshaking = False
+                return
+            # Session migration: drop the previous serving connection.
+            if sess.owner is not None and sess.owner is not self:
+                sess.owner.close()
+        sess.owner = self
+        self.session = sess
+        self._send({'protocolVersion': 0, 'timeOut': sess.timeout,
+                    'sessionId': sess.id, 'passwd': sess.passwd})
+        self.codec.handshaking = False
+        self._subscribe()
+
+    # -- request dispatch --
+
+    def _handle_request(self, pkt: dict) -> None:
+        if self.session is None or self.session.expired:
+            self._reply(pkt['xid'], pkt['opcode'], err='SESSION_EXPIRED')
+            return
+        self.db.touch_session(self.session)
+        op = pkt['opcode']
+        xid = pkt['xid']
+        try:
+            handler = getattr(self, '_op_' + op.lower(), None)
+            if handler is None:
+                self._reply(xid, op, err='UNIMPLEMENTED')
+                return
+            handler(pkt)
+        except ZKOpError as e:
+            # Failed reads with a watch flag still arm existence watches
+            # where the protocol says so (handled inside the op); other
+            # failures just carry the code.
+            self._reply(xid, op, err=e.code)
+
+    def _op_ping(self, pkt: dict) -> None:
+        self._reply(pkt['xid'], 'PING')
+
+    def _op_create(self, pkt: dict) -> None:
+        path = self.db.create(pkt['path'], pkt['data'], pkt['acl'],
+                              CreateFlag(pkt['flags']), self.session)
+        self._reply(pkt['xid'], 'CREATE', path=path)
+
+    def _op_delete(self, pkt: dict) -> None:
+        self.db.delete(pkt['path'], pkt['version'])
+        self._reply(pkt['xid'], 'DELETE')
+
+    def _op_get_data(self, pkt: dict) -> None:
+        try:
+            data, stat = self.db.get_data(pkt['path'])
+        except ZKOpError:
+            raise
+        if pkt.get('watch'):
+            self.data_watches[pkt['path']] = True
+        self._reply(pkt['xid'], 'GET_DATA', data=data, stat=stat)
+
+    def _op_set_data(self, pkt: dict) -> None:
+        stat = self.db.set_data(pkt['path'], pkt['data'], pkt['version'])
+        self._reply(pkt['xid'], 'SET_DATA', stat=stat)
+
+    def _op_exists(self, pkt: dict) -> None:
+        try:
+            stat = self.db.exists(pkt['path'])
+        except ZKOpError:
+            # EXISTS with watch on a missing node arms an existence
+            # watch that fires CREATED later.
+            if pkt.get('watch'):
+                self.data_watches[pkt['path']] = True
+            raise
+        if pkt.get('watch'):
+            self.data_watches[pkt['path']] = True
+        self._reply(pkt['xid'], 'EXISTS', stat=stat)
+
+    def _op_get_children(self, pkt: dict) -> None:
+        children, stat = self.db.get_children(pkt['path'])
+        if pkt.get('watch'):
+            self.child_watches[pkt['path']] = True
+        self._reply(pkt['xid'], 'GET_CHILDREN', children=children)
+
+    def _op_get_children2(self, pkt: dict) -> None:
+        children, stat = self.db.get_children(pkt['path'])
+        if pkt.get('watch'):
+            self.child_watches[pkt['path']] = True
+        self._reply(pkt['xid'], 'GET_CHILDREN2', children=children,
+                    stat=stat)
+
+    def _op_get_acl(self, pkt: dict) -> None:
+        acl, stat = self.db.get_acl(pkt['path'])
+        self._reply(pkt['xid'], 'GET_ACL', acl=acl, stat=stat)
+
+    def _op_sync(self, pkt: dict) -> None:
+        # Single shared database: every server is trivially caught up.
+        self._reply(pkt['xid'], 'SYNC')
+
+    def _op_close_session(self, pkt: dict) -> None:
+        self.db.close_session(self.session.id)
+        self._reply(pkt['xid'], 'CLOSE_SESSION')
+        self.close()
+
+    def _op_set_watches(self, pkt: dict) -> None:
+        """Re-arm watches after reconnect, sending catch-up
+        notifications for anything that moved past relZxid."""
+        rel = pkt['relZxid']
+        events = pkt['events']
+        for path in events.get('dataChanged', ()):
+            node = self.db.nodes.get(path)
+            if node is None:
+                self.notify('DELETED', path)
+            else:
+                self.data_watches[path] = True
+                if node.mzxid > rel:
+                    self.data_watches.pop(path, None)
+                    self.notify('DATA_CHANGED', path)
+        for path in events.get('createdOrDestroyed', ()):
+            node = self.db.nodes.get(path)
+            if node is None:
+                # Missing node: the watcher may have seen it alive, so
+                # send DELETED (real ZK does the same for exist watches
+                # — it cannot know the node never existed either).
+                self.notify('DELETED', path)
+            elif node.czxid > rel:
+                self.notify('CREATED', path)
+            else:
+                self.data_watches[path] = True
+        for path in events.get('childrenChanged', ()):
+            node = self.db.nodes.get(path)
+            if node is None:
+                self.notify('DELETED', path)
+            else:
+                self.child_watches[path] = True
+                if node.pzxid > rel:
+                    self.child_watches.pop(path, None)
+                    self.notify('CHILDREN_CHANGED', path)
+        self._reply(pkt['xid'], 'SET_WATCHES')
+
+
+class ZKServer:
+    """One listening endpoint over a ZKDatabase."""
+
+    def __init__(self, db: ZKDatabase | None = None,
+                 host: str = '127.0.0.1', port: int = 0):
+        self.db = db if db is not None else ZKDatabase()
+        self.host = host
+        self.port = port
+        self._server: asyncio.base_events.Server | None = None
+        self.conns: set[ServerConnection] = set()
+
+    async def start(self) -> 'ZKServer':
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info('ZK server listening on %s:%d', self.host, self.port)
+        return self
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        conn = ServerConnection(self, reader, writer)
+        self.conns.add(conn)
+        await conn.run()
+
+    async def stop(self) -> None:
+        """Kill the server: stop listening and sever every connection.
+        Sessions live in the database and keep their expiry clocks
+        running — exactly what a crashed ensemble member looks like."""
+        for conn in list(self.conns):
+            conn.close()
+        self.conns.clear()
+        if self._server is not None:
+            self._server.close()
+            # In Python >= 3.12.1 wait_closed also waits for all client
+            # handlers to return, so connections must be severed first.
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+
+class ZKEnsemble:
+    """N servers over one shared database: localhost stand-in for a ZK
+    quorum (reference analogue: test/multi-node.test.js's three real
+    servers on distinct ports)."""
+
+    def __init__(self, count: int = 3, host: str = '127.0.0.1'):
+        self.db = ZKDatabase()
+        self.servers = [ZKServer(self.db, host=host) for _ in range(count)]
+
+    async def start(self) -> 'ZKEnsemble':
+        for s in self.servers:
+            await s.start()
+        return self
+
+    async def stop(self) -> None:
+        for s in self.servers:
+            await s.stop()
+
+    async def kill(self, idx: int) -> None:
+        await self.servers[idx].stop()
+
+    async def restart(self, idx: int) -> None:
+        """Bring a killed member back on its old port."""
+        srv = self.servers[idx]
+        assert srv._server is None, 'server still running'
+        srv._server = await asyncio.start_server(
+            srv._on_client, srv.host, srv.port)
+
+    def addresses(self) -> list[tuple[str, int]]:
+        return [s.address for s in self.servers]

@@ -1,0 +1,287 @@
+"""The in-process ZooKeeper server's data model.
+
+The reference tests against a real ZooKeeper JVM spawned as a child
+process (reference: test/zkserver.js) — unavailable here, so this module
+implements the server-side semantics the client exercises: the znode
+tree with full Stat bookkeeping, zxid allocation, session lifecycle with
+expiry timers and ephemeral cleanup, sequential-node numbering, and
+change events that per-connection watch tables subscribe to.
+
+One ``ZKDatabase`` can back several listening servers at once, which is
+how the 3-node-ensemble failover tests run without a real quorum: the
+servers share committed state (as a ZAB quorum would) while sessions and
+watches keep their real locality semantics — a watch lives on the
+connection that set it; a session survives its server dying as long as
+the client resumes it anywhere within the timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import secrets
+import time
+
+from ..protocol.consts import CreateFlag
+from ..protocol.records import ACL, OPEN_ACL_UNSAFE, Stat
+from ..utils.events import EventEmitter
+
+log = logging.getLogger('zkstream_tpu.server.store')
+
+
+class ZKOpError(Exception):
+    """A server-side operation failure, named by protocol error code."""
+
+    def __init__(self, code: str):
+        super().__init__(code)
+        self.code = code
+
+
+@dataclasses.dataclass
+class Znode:
+    data: bytes = b''
+    acl: tuple = OPEN_ACL_UNSAFE
+    czxid: int = 0
+    mzxid: int = 0
+    pzxid: int = 0
+    ctime: int = 0
+    mtime: int = 0
+    version: int = 0
+    cversion: int = 0
+    aversion: int = 0
+    ephemeral_owner: int = 0
+    children: set = dataclasses.field(default_factory=set)
+    #: Monotonic sequential-suffix counter (real ZK derives this from
+    #: cversion; an explicit counter keeps numbering stable across
+    #: deletes).
+    seq: int = 0
+
+    def stat(self) -> Stat:
+        return Stat(czxid=self.czxid, mzxid=self.mzxid, ctime=self.ctime,
+                    mtime=self.mtime, version=self.version,
+                    cversion=self.cversion, aversion=self.aversion,
+                    ephemeralOwner=self.ephemeral_owner,
+                    dataLength=len(self.data),
+                    numChildren=len(self.children), pzxid=self.pzxid)
+
+
+@dataclasses.dataclass
+class ZKServerSession:
+    id: int
+    passwd: bytes
+    timeout: int
+    ephemerals: set = dataclasses.field(default_factory=set)
+    expired: bool = False
+    closed: bool = False
+    #: The server connection currently serving this session, if any.
+    owner: object = None
+    expiry_handle: asyncio.TimerHandle | None = None
+
+
+def parent_path(path: str) -> str:
+    idx = path.rfind('/')
+    return path[:idx] if idx > 0 else '/'
+
+
+def validate_path(path: str) -> None:
+    if not path.startswith('/'):
+        raise ZKOpError('BAD_ARGUMENTS')
+    if path != '/' and path.endswith('/'):
+        raise ZKOpError('BAD_ARGUMENTS')
+    if '//' in path:
+        raise ZKOpError('BAD_ARGUMENTS')
+
+
+class ZKDatabase(EventEmitter):
+    """Committed state shared by every server of a (simulated) ensemble.
+
+    Change events (for watch tables): ``created(path, zxid)``,
+    ``deleted(path, zxid)``, ``dataChanged(path, zxid)``,
+    ``childrenChanged(path, zxid)``, ``sessionExpired(session_id)``.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.nodes: dict[str, Znode] = {'/': Znode()}
+        self.zxid = 0
+        self.sessions: dict[int, ZKServerSession] = {}
+        # Like real ZK's (timestamp << 24) seed, masked into int64 range.
+        self._next_session = (int(time.time() * 1000) << 24) & 0x7fffffffffff0000
+
+    # -- zxid / time --
+
+    def next_zxid(self) -> int:
+        self.zxid += 1
+        return self.zxid
+
+    @staticmethod
+    def now_ms() -> int:
+        return int(time.time() * 1000)
+
+    # -- session lifecycle --
+
+    def create_session(self, timeout: int) -> ZKServerSession:
+        self._next_session += 1
+        sess = ZKServerSession(id=self._next_session,
+                               passwd=secrets.token_bytes(16),
+                               timeout=timeout)
+        self.sessions[sess.id] = sess
+        self.touch_session(sess)
+        log.debug('created session %016x timeout %d', sess.id, timeout)
+        return sess
+
+    def resume_session(self, session_id: int,
+                       passwd: bytes) -> ZKServerSession | None:
+        sess = self.sessions.get(session_id)
+        if sess is None or sess.expired or sess.closed:
+            return None
+        if sess.passwd != passwd:
+            return None
+        self.touch_session(sess)
+        return sess
+
+    def touch_session(self, sess: ZKServerSession) -> None:
+        """Reset the session's expiry clock; called on every packet the
+        ensemble sees from it."""
+        if sess.expiry_handle is not None:
+            sess.expiry_handle.cancel()
+        loop = asyncio.get_event_loop()
+        sess.expiry_handle = loop.call_later(
+            sess.timeout / 1000.0, lambda: self.expire_session(sess.id))
+
+    def expire_session(self, session_id: int) -> None:
+        sess = self.sessions.get(session_id)
+        if sess is None or sess.expired or sess.closed:
+            return
+        sess.expired = True
+        if sess.expiry_handle is not None:
+            sess.expiry_handle.cancel()
+            sess.expiry_handle = None
+        log.info('session %016x expired', session_id)
+        self._reap_ephemerals(sess)
+        self.emit('sessionExpired', session_id)
+
+    def close_session(self, session_id: int) -> None:
+        sess = self.sessions.get(session_id)
+        if sess is None or sess.closed:
+            return
+        sess.closed = True
+        if sess.expiry_handle is not None:
+            sess.expiry_handle.cancel()
+            sess.expiry_handle = None
+        log.debug('session %016x closed', session_id)
+        self._reap_ephemerals(sess)
+
+    def _reap_ephemerals(self, sess: ZKServerSession) -> None:
+        # Deepest-first so children go before parents.
+        for path in sorted(sess.ephemerals, key=len, reverse=True):
+            if path in self.nodes:
+                try:
+                    self.delete(path, -1)
+                except ZKOpError:
+                    log.warning('could not reap ephemeral %s', path)
+        sess.ephemerals.clear()
+
+    # -- znode operations --
+
+    def create(self, path: str, data: bytes, acl, flags: CreateFlag,
+               session: ZKServerSession | None = None) -> str:
+        validate_path(path)
+        if path == '/':
+            raise ZKOpError('NODE_EXISTS')
+        parent = self.nodes.get(parent_path(path))
+        if parent is None:
+            raise ZKOpError('NO_NODE')
+        if parent.ephemeral_owner != 0:
+            raise ZKOpError('NO_CHILDREN_FOR_EPHEMERALS')
+
+        if flags & CreateFlag.SEQUENTIAL:
+            path = '%s%010d' % (path, parent.seq)
+            parent.seq += 1
+        if path in self.nodes:
+            raise ZKOpError('NODE_EXISTS')
+
+        zxid = self.next_zxid()
+        now = self.now_ms()
+        node = Znode(data=data, acl=tuple(acl) if acl else OPEN_ACL_UNSAFE,
+                     czxid=zxid, mzxid=zxid, pzxid=zxid,
+                     ctime=now, mtime=now)
+        if flags & CreateFlag.EPHEMERAL:
+            if session is None:
+                raise ZKOpError('BAD_ARGUMENTS')
+            node.ephemeral_owner = session.id
+            session.ephemerals.add(path)
+        self.nodes[path] = node
+        parent.children.add(path.rsplit('/', 1)[1])
+        parent.cversion += 1
+        parent.pzxid = zxid
+
+        self.emit('created', path, zxid)
+        self.emit('childrenChanged', parent_path(path), zxid)
+        return path
+
+    def delete(self, path: str, version: int) -> None:
+        validate_path(path)
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        if node.children:
+            raise ZKOpError('NOT_EMPTY')
+        if version >= 0 and version != node.version:
+            raise ZKOpError('BAD_VERSION')
+
+        zxid = self.next_zxid()
+        del self.nodes[path]
+        ppath = parent_path(path)
+        parent = self.nodes.get(ppath)
+        if parent is not None:
+            parent.children.discard(path.rsplit('/', 1)[1])
+            parent.cversion += 1
+            parent.pzxid = zxid
+        if node.ephemeral_owner:
+            sess = self.sessions.get(node.ephemeral_owner)
+            if sess is not None:
+                sess.ephemerals.discard(path)
+
+        self.emit('deleted', path, zxid)
+        self.emit('childrenChanged', ppath, zxid)
+
+    def set_data(self, path: str, data: bytes, version: int) -> Stat:
+        validate_path(path)
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        if version >= 0 and version != node.version:
+            raise ZKOpError('BAD_VERSION')
+        zxid = self.next_zxid()
+        node.data = data
+        node.version += 1
+        node.mzxid = zxid
+        node.mtime = self.now_ms()
+        self.emit('dataChanged', path, zxid)
+        return node.stat()
+
+    def get_data(self, path: str) -> tuple[bytes, Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return node.data, node.stat()
+
+    def exists(self, path: str) -> Stat:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return node.stat()
+
+    def get_children(self, path: str) -> tuple[list[str], Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return sorted(node.children), node.stat()
+
+    def get_acl(self, path: str) -> tuple[list[ACL], Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return list(node.acl), node.stat()

@@ -1,0 +1,7 @@
+"""Cross-cutting infrastructure: event emitter, Moore-machine FSM base,
+metrics (the rebuild's equivalents of the reference's mooremachine /
+events / artedi dependencies)."""
+
+from .events import EventEmitter  # noqa: F401
+from .fsm import FSM, StateScope  # noqa: F401
+from .metrics import Collector, Counter  # noqa: F401

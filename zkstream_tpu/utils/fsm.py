@@ -1,0 +1,144 @@
+"""A Moore-machine FSM base with auto-disposing state scopes.
+
+The reference builds every stateful component (client, connection,
+session, watch events) on the mooremachine library's pattern: each state
+is a ``state_<name>`` method receiving a scope handle ``S``; listeners
+and timers registered through ``S`` are torn down automatically on the
+next transition.  That discipline is what makes the protocol's many
+races tractable, so this module provides the same contract for asyncio:
+
+- ``goto_state(name)`` disposes the current scope (listeners removed,
+  timers cancelled) and runs ``state_<name>(S)``;
+- ``S.on(emitter, event, cb)`` / ``S.timeout(ms, cb)`` /
+  ``S.interval(ms, cb)`` / ``S.immediate(cb)`` are scope-bound;
+- dotted substates (``armed.doublecheck``) keep the parent state's scope
+  alive, inheriting its transitions, exactly like mooremachine substates
+  (reference: lib/zk-session.js:671-673);
+- ``is_in_state('armed')`` is true while in ``armed.doublecheck``;
+- every transition emits ``stateChanged`` with the new state name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from .events import EventEmitter
+
+
+class StateScope:
+    """Handle passed to ``state_*`` methods; everything registered through
+    it is disposed when the machine leaves the state."""
+
+    def __init__(self, fsm: 'FSM', state: str):
+        self._fsm = fsm
+        self._state = state
+        self._disposers: list[Callable[[], None]] = []
+        self._valid = True
+
+    def on(self, emitter: EventEmitter, event: str, cb: Callable) -> None:
+        def guarded(*args):
+            if self._valid:
+                cb(*args)
+        emitter.on(event, guarded)
+        self._disposers.append(
+            lambda: emitter.remove_listener(event, guarded))
+
+    def timeout(self, ms: float, cb: Callable[[], None]) -> asyncio.TimerHandle:
+        loop = asyncio.get_event_loop()
+        handle = loop.call_later(ms / 1000.0,
+                                 lambda: self._valid and cb())
+        self._disposers.append(handle.cancel)
+        return handle
+
+    def interval(self, ms: float, cb: Callable[[], None]) -> None:
+        loop = asyncio.get_event_loop()
+        state = {}
+
+        def fire():
+            if not self._valid:
+                return
+            cb()
+            if self._valid:
+                state['h'] = loop.call_later(ms / 1000.0, fire)
+
+        state['h'] = loop.call_later(ms / 1000.0, fire)
+        self._disposers.append(lambda: state['h'].cancel())
+
+    def immediate(self, cb: Callable[[], None]) -> None:
+        loop = asyncio.get_event_loop()
+        handle = loop.call_soon(lambda: self._valid and cb())
+        self._disposers.append(handle.cancel)
+
+    def goto_state(self, name: str) -> None:
+        if self._valid:
+            self._fsm._transition(name)
+
+    def _dispose(self) -> None:
+        self._valid = False
+        for d in self._disposers:
+            d()
+        self._disposers.clear()
+
+
+class FSM(EventEmitter):
+    """Base class: subclasses define ``state_<name>(self, S)`` methods and
+    call ``super().__init__(initial_state)``."""
+
+    def __init__(self, initial: str):
+        super().__init__()
+        self._state: str | None = None
+        #: Scope stack: one entry per dotted level of the current state
+        #: (['armed'] or ['armed', 'armed.doublecheck']).
+        self._scopes: list[tuple[str, StateScope]] = []
+        self._in_transition = False
+        self._queued: str | None = None
+        self._transition(initial)
+
+    def get_state(self) -> str:
+        return self._state or ''
+
+    def is_in_state(self, name: str) -> bool:
+        if self._state is None:
+            return False
+        return self._state == name or self._state.startswith(name + '.')
+
+    def _transition(self, name: str) -> None:
+        # A transition triggered from inside a state_* entry function is
+        # deferred until the entry function returns (mooremachine allows
+        # synchronous re-entry; a queue keeps the bookkeeping sane).
+        if self._in_transition:
+            self._queued = name
+            return
+
+        # Dispose scopes that are not parents of the new state.  Entering
+        # 'armed.doublecheck' from 'armed' keeps the 'armed' scope alive;
+        # entering 'wait_session' from 'armed.doublecheck' disposes both.
+        keep = 0
+        parts = name.split('.')
+        prefixes = ['.'.join(parts[:i + 1]) for i in range(len(parts) - 1)]
+        for st, _scope in self._scopes:
+            if keep < len(prefixes) and st == prefixes[keep]:
+                keep += 1
+            else:
+                break
+        for _st, scope in reversed(self._scopes[keep:]):
+            scope._dispose()
+        del self._scopes[keep:]
+
+        handler = getattr(self, 'state_' + name.replace('.', '_'), None)
+        if handler is None:
+            raise AttributeError('%s has no state %r' %
+                                 (type(self).__name__, name))
+        scope = StateScope(self, name)
+        self._scopes.append((name, scope))
+        self._state = name
+        self._in_transition = True
+        try:
+            handler(scope)
+        finally:
+            self._in_transition = False
+        self.emit('stateChanged', name)
+        if self._queued is not None:
+            nxt, self._queued = self._queued, None
+            self._transition(nxt)

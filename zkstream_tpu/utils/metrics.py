@@ -1,0 +1,57 @@
+"""Prometheus-style metrics counters.
+
+The reference records client events through the artedi collector
+(reference: lib/client.js:46-61, lib/zk-session.js:61-65).  This is a
+dependency-free equivalent: labelled counters plus text exposition in
+the Prometheus format.  A caller may supply their own ``Collector`` to
+``Client`` or let one be created internally, as in the reference.
+"""
+
+from __future__ import annotations
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str = ''):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def increment(self, labels: dict[str, str] | None = None,
+                  by: float = 1.0) -> None:
+        key = tuple(sorted((labels or {}).items()))
+        self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, labels: dict[str, str] | None = None) -> float:
+        return self._values.get(tuple(sorted((labels or {}).items())), 0.0)
+
+    def expose(self) -> str:
+        lines = []
+        if self.help:
+            lines.append('# HELP %s %s' % (self.name, self.help))
+        lines.append('# TYPE %s counter' % (self.name,))
+        for key, val in sorted(self._values.items()):
+            if key:
+                labelstr = '{%s}' % ','.join(
+                    '%s="%s"' % (k, v) for k, v in key)
+            else:
+                labelstr = ''
+            lines.append('%s%s %s' % (self.name, labelstr, val))
+        return '\n'.join(lines)
+
+
+class Collector:
+    def __init__(self) -> None:
+        self._counters: dict[str, Counter] = {}
+
+    def counter(self, name: str, help_text: str = '') -> Counter:
+        """Create (or fetch) a counter by name — idempotent, like
+        artedi's collector.counter()."""
+        if name not in self._counters:
+            self._counters[name] = Counter(name, help_text)
+        return self._counters[name]
+
+    def get_collector(self, name: str) -> Counter:
+        return self._counters[name]
+
+    def expose(self) -> str:
+        return '\n'.join(c.expose() for c in self._counters.values())
