@@ -1,0 +1,12 @@
+"""Shared test helpers."""
+
+import asyncio
+
+
+async def wait_until(cond, timeout=5.0, interval=0.02):
+    """Poll ``cond`` until true (the reference's test/utils.js wait())."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError('condition never became true')
+        await asyncio.sleep(interval)

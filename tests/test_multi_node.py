@@ -1,0 +1,158 @@
+"""Ensemble tests: write visibility with sync, cross-server watches, and
+ephemeral survival across backend kill — the rebuild's equivalent of the
+reference's test/multi-node.test.js (three real ZK servers on localhost
+there; three in-process servers over a shared database here)."""
+
+import asyncio
+
+import pytest
+
+from helpers import wait_until
+from zkstream_tpu import Client, CreateFlag, ZKError
+from zkstream_tpu.server import ZKEnsemble
+
+
+@pytest.fixture
+def ensemble(event_loop):
+    ens = event_loop.run_until_complete(ZKEnsemble(3).start())
+    yield ens
+    event_loop.run_until_complete(ens.stop())
+
+
+def make_client(ensemble, pin=None, **kw):
+    """Create a client over all ensemble members; ``pin`` forces the
+    preference order to start at that member (the reference pins via a
+    cueball key-sort hack, multi-node.test.js:248-255)."""
+    kw.setdefault('session_timeout', 5000)
+    addrs = ensemble.addresses()
+    if pin is not None:
+        addrs = addrs[pin:] + addrs[:pin]
+    c = Client(servers=addrs, shuffle_backends=False, **kw)
+    c.start()
+    return c
+
+
+async def test_write_visibility_across_servers(ensemble):
+    """Write via one member, sync + read via another
+    (reference: multi-node.test.js:107-165)."""
+    c1 = make_client(ensemble, pin=0)
+    c2 = make_client(ensemble, pin=2)
+    await c1.wait_connected(timeout=5)
+    await c2.wait_connected(timeout=5)
+    assert c1.current_connection().backend.key != \
+        c2.current_connection().backend.key
+
+    await c1.create('/viz', b'hello')
+    await c2.sync('/viz')
+    data, _ = await c2.get('/viz')
+    assert data == b'hello'
+    await c1.close()
+    await c2.close()
+
+
+async def test_cross_server_data_watch(ensemble):
+    """Watch via one member, write via another
+    (reference: multi-node.test.js:167-231)."""
+    c1 = make_client(ensemble, pin=0)
+    c2 = make_client(ensemble, pin=1)
+    await c1.wait_connected(timeout=5)
+    await c2.wait_connected(timeout=5)
+
+    await c1.create('/xw', b'v0')
+    seen = []
+    c1.watcher('/xw').on('dataChanged',
+                         lambda data, stat: seen.append(bytes(data)))
+    await wait_until(lambda: seen == [b'v0'])
+    await c2.set('/xw', b'v1')
+    await wait_until(lambda: seen == [b'v0', b'v1'])
+    await c1.close()
+    await c2.close()
+
+
+async def test_ephemeral_survives_backend_kill(ensemble):
+    """Kill the member the owner is pinned to: the session must resume
+    on another member within the timeout, the ephemeral must survive,
+    and the deleted watcher must never fire
+    (reference: multi-node.test.js:233-350)."""
+    owner = make_client(ensemble, pin=0)
+    observer = make_client(ensemble, pin=1)
+    await owner.wait_connected(timeout=5)
+    await observer.wait_connected(timeout=5)
+    assert owner.current_connection().backend.key == \
+        '127.0.0.1:%d' % ensemble.servers[0].port
+
+    await owner.create('/eph-ha', b'mine', flags=CreateFlag.EPHEMERAL)
+
+    deleted = []
+    w = observer.watcher('/eph-ha')
+    w.on('dataChanged', lambda *a: None)
+    w.on('deleted', lambda *a: deleted.append(True))
+    await asyncio.sleep(0.1)
+
+    dying = owner.current_connection()
+    await ensemble.kill(0)
+    await wait_until(lambda: not dying.is_in_state('connected'),
+                     timeout=10)
+    await wait_until(lambda: owner.is_connected(), timeout=10)
+    # Resumed on a different member.
+    assert owner.current_connection().backend.key != \
+        '127.0.0.1:%d' % ensemble.servers[0].port
+
+    data, stat = await observer.get('/eph-ha')
+    assert data == b'mine'
+    assert stat.ephemeralOwner == owner.session.session_id
+    assert deleted == []
+
+    # Restart the dead member and verify again through it.
+    await ensemble.restart(0)
+    c3 = make_client(ensemble, pin=0)
+    await c3.wait_connected(timeout=5)
+    data, _ = await c3.get('/eph-ha')
+    assert data == b'mine'
+    assert deleted == []
+
+    await owner.close()
+    # Clean close deletes the ephemeral; observer hears about it.
+    await wait_until(lambda: deleted == [True], timeout=5)
+    with pytest.raises(ZKError):
+        await observer.stat('/eph-ha')
+    await observer.close()
+    await c3.close()
+
+
+async def test_session_migration_to_preferred_backend(ensemble):
+    """A client connected to a less-preferred member migrates its live
+    session back when the preferred one returns (decoherence +
+    reattaching with revert; reference: lib/zk-session.js:265-339,
+    lib/client.js:110-111)."""
+    await ensemble.kill(0)
+    c = make_client(ensemble, pin=0, decoherence_interval=500)
+    await c.wait_connected(timeout=10)
+    # Connected to a fallback member.
+    fallback = c.current_connection().backend.key
+    assert fallback != '127.0.0.1:%d' % ensemble.servers[0].port
+    sid = c.session.session_id
+
+    await ensemble.restart(0)
+    # Decoherence fires every 500 ms; the session should migrate.
+    await wait_until(
+        lambda: c.is_connected() and
+        c.current_connection().backend.key ==
+        '127.0.0.1:%d' % ensemble.servers[0].port,
+        timeout=10)
+    assert c.session.session_id == sid  # moved, not recreated
+    await c.ping()
+    await c.close()
+
+
+async def test_sequential_counter_shared_across_servers(ensemble):
+    c1 = make_client(ensemble, pin=0)
+    c2 = make_client(ensemble, pin=1)
+    await c1.wait_connected(timeout=5)
+    await c2.wait_connected(timeout=5)
+    p1 = await c1.create('/seq-', b'', flags=CreateFlag.SEQUENTIAL)
+    p2 = await c2.create('/seq-', b'', flags=CreateFlag.SEQUENTIAL)
+    assert p1 == '/seq-0000000000'
+    assert p2 == '/seq-0000000001'
+    await c1.close()
+    await c2.close()

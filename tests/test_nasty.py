@@ -1,0 +1,224 @@
+"""Adversarial tests: hostile, broken, and half-dead servers — the
+rebuild's equivalent of the reference's test/nasty.test.js."""
+
+import asyncio
+import struct
+
+import pytest
+
+from helpers import wait_until
+from zkstream_tpu import Client, ZKNotConnectedError
+from zkstream_tpu.io.pool import RecoveryPolicy
+from zkstream_tpu.protocol.framing import PacketCodec
+from zkstream_tpu.server import ZKServer
+
+FAST = dict(connect_policy=RecoveryPolicy(timeout=300, retries=2, delay=50),
+            default_policy=RecoveryPolicy(timeout=300, retries=2, delay=100))
+
+
+def failing_client(port, **kw):
+    c = Client(address='127.0.0.1', port=port, session_timeout=2000,
+               **{**FAST, **kw})
+    failed = []
+    c.on('failed', failed.append)
+    connected = []
+    c.on('connect', lambda: connected.append(True))
+    c.start()
+    return c, failed, connected
+
+
+async def test_connect_refused_emits_failed():
+    # Port 1 refuses connections (reference: basic.test.js:1399-1418).
+    c, failed, connected = failing_client(1)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    with pytest.raises(ZKNotConnectedError):
+        await c.get('/x')
+    await c.close()
+
+
+async def test_immediate_close_server():
+    # Accepts then instantly destroys every connection
+    # (reference: basic.test.js:1420-1448).
+    async def handler(reader, writer):
+        writer.close()
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    await c.close()
+    srv.close()
+
+
+async def test_hanging_server():
+    # Accepts and never replies to the handshake: connect attempts must
+    # time out, not hang (reference: nasty.test.js:245-285).
+    async def handler(reader, writer):
+        await reader.read(65536)
+        await asyncio.sleep(3600)
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    await c.close()
+    srv.close()
+
+
+@pytest.mark.parametrize('prefix', [
+    struct.pack('>i', -10),               # negative length
+    struct.pack('>i', 17 * 1024 * 1024),  # over the 16 MiB cap
+    struct.pack('>i', 2 ** 31 - 1),       # absurd length
+])
+async def test_awful_server_bad_length_prefix(prefix):
+    """Servers replying with insane length prefixes must produce a
+    protocol error and eventually 'failed', never a crash or hang
+    (reference: nasty.test.js:105-189)."""
+    async def handler(reader, writer):
+        await reader.read(65536)   # swallow the ConnectRequest
+        writer.write(prefix + b'garbage')
+        try:
+            await writer.drain()
+        except ConnectionError:
+            pass
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    await c.close()
+    srv.close()
+
+
+async def test_zero_length_frames_flood():
+    # Zero-length frames are valid framing but undecodable bodies.
+    async def handler(reader, writer):
+        await reader.read(65536)
+        writer.write(struct.pack('>i', 0) * 100)
+        try:
+            await writer.drain()
+        except ConnectionError:
+            pass
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    await c.close()
+    srv.close()
+
+
+async def test_version_incompatible_server():
+    """A fake ZK server built from this package's own codec in server
+    mode, replying protocolVersion 1: the handshake must be rejected
+    (reference: nasty.test.js:294-361 — the same trick, except the
+    reference's server-mode encoder does not actually exist)."""
+    async def handler(reader, writer):
+        codec = PacketCodec(server=True)
+        data = await reader.read(65536)
+        [creq] = codec.decode(data)
+        writer.write(codec.encode({'protocolVersion': 1,
+                                   'timeOut': creq['timeOut'],
+                                   'sessionId': 0x1234,
+                                   'passwd': b'p' * 16}))
+        await writer.drain()
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    assert connected == []
+    await c.close()
+    srv.close()
+
+
+async def test_flaky_listener_attach_race():
+    """A listener that kills its first few connections mid-handshake
+    then starts behaving: the client must ride through the attach-race
+    guard and eventually connect (reference: nasty.test.js:28-103)."""
+    real = await ZKServer().start()
+    kills = {'n': 0}
+
+    async def handler(reader, writer):
+        if kills['n'] < 3:
+            kills['n'] += 1
+            # Read the ConnectRequest, then die mid-handshake.
+            await reader.read(65536)
+            writer.close()
+            return
+        # Proxy to the real server from here on.
+        try:
+            r2, w2 = await asyncio.open_connection('127.0.0.1', real.port)
+        except ConnectionError:
+            writer.close()
+            return
+
+        async def pump(src, dst):
+            try:
+                while True:
+                    chunk = await src.read(65536)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+                    await dst.drain()
+            except ConnectionError:
+                pass
+            finally:
+                try:
+                    dst.close()
+                except RuntimeError:
+                    pass
+        await asyncio.gather(pump(reader, w2), pump(r2, writer))
+
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    port = srv.sockets[0].getsockname()[1]
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: connected, timeout=15)
+    assert await c.ping() >= 0
+    await c.close()
+    srv.close()
+    await real.stop()
+
+
+async def test_recovery_after_failed():
+    """'failed' is not terminal: monitor mode keeps dialing and the
+    client recovers when a server appears (cueball monitor semantics)."""
+    # Reserve a port by binding and closing.
+    tmp = await asyncio.start_server(lambda r, w: None, '127.0.0.1', 0)
+    port = tmp.sockets[0].getsockname()[1]
+    tmp.close()
+    await tmp.wait_closed()
+
+    c, failed, connected = failing_client(port)
+    await wait_until(lambda: failed, timeout=10)
+    srv = await ZKServer(host='127.0.0.1', port=port).start()
+    await wait_until(lambda: connected, timeout=15)
+    assert await c.ping() >= 0
+    await c.close()
+    await srv.stop()
+
+
+async def test_argument_validation():
+    c = Client(address='127.0.0.1', port=1)
+    with pytest.raises(TypeError):
+        await c.get(123)
+    with pytest.raises(ValueError):
+        await c.get('no-slash')
+    with pytest.raises(TypeError):
+        await c.create('/x', 'not-bytes')
+    with pytest.raises(TypeError):
+        await c.delete('/x', 'not-an-int')
+    with pytest.raises(TypeError):
+        c.watcher(None)
+
+
+async def test_argument_validation_bool_version_and_closed_watcher():
+    c = Client(address='127.0.0.1', port=1)
+    with pytest.raises(TypeError):
+        await c.delete('/x', True)   # bool is not a version
+    with pytest.raises(TypeError):
+        await c.set('/x', b'd', version='7')
+    # watcher() on a closed client raises cleanly, not AttributeError.
+    await c.close()
+    with pytest.raises(ZKNotConnectedError):
+        c.watcher('/x')

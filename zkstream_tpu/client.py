@@ -247,6 +247,28 @@ class Client(FSM):
             raise ZKNotConnectedError()
         return conn
 
+    @staticmethod
+    def _check_path(path) -> None:
+        """Argument validation, matching the reference's assert-plus
+        throws on bad inputs (reference: test/nasty.test.js:197-221)."""
+        if not isinstance(path, str):
+            raise TypeError('path must be a str, got %r' % (type(path),))
+        if not path.startswith('/'):
+            raise ValueError('path must start with /: %r' % (path,))
+
+    @staticmethod
+    def _check_data(data) -> None:
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            raise TypeError('data must be bytes, got %r' % (type(data),))
+
+    @staticmethod
+    def _check_version(version) -> None:
+        # bool is an int subclass; a True/False version is always a
+        # programmer error, not version 1/0.
+        if not isinstance(version, int) or isinstance(version, bool):
+            raise TypeError('version must be an int, got %r'
+                            % (type(version),))
+
     # -- operations (reference: lib/client.js:318-601) --
 
     async def ping(self) -> float:
@@ -267,12 +289,14 @@ class Client(FSM):
 
     async def list(self, path: str) -> tuple[list[str], Stat]:
         """Children of a znode, with its stat."""
+        self._check_path(path)
         conn = self._conn_or_raise()
         pkt = await conn.request({'opcode': 'GET_CHILDREN2', 'path': path,
                                   'watch': False}).as_future()
         return pkt['children'], pkt['stat']
 
     async def get(self, path: str) -> tuple[bytes, Stat]:
+        self._check_path(path)
         conn = self._conn_or_raise()
         pkt = await conn.request({'opcode': 'GET_DATA', 'path': path,
                                   'watch': False}).as_future()
@@ -282,6 +306,8 @@ class Client(FSM):
                      acl=None, flags: CreateFlag | int = 0) -> str:
         """Create a znode; resolves to the created path (which differs
         from the request path for SEQUENTIAL nodes)."""
+        self._check_path(path)
+        self._check_data(data)
         if acl is None:
             acl = list(OPEN_ACL_UNSAFE)
         conn = self._conn_or_raise()
@@ -299,6 +325,8 @@ class Client(FSM):
         leaf (reference: lib/client.js:412-481)."""
         from .protocol.errors import ZKError
 
+        self._check_path(path)
+        self._check_data(data)
         nodes = path.split('/')[1:]
         current = ''
         result = None
@@ -321,6 +349,9 @@ class Client(FSM):
         """Set a znode's data; resolves to the new stat.  (The reference
         passes its callback a path field SET_DATA replies do not carry,
         lib/client.js:503-504 — the stat is the useful payload.)"""
+        self._check_path(path)
+        self._check_data(data)
+        self._check_version(version)
         conn = self._conn_or_raise()
         pkt = await conn.request({'opcode': 'SET_DATA', 'path': path,
                                   'data': data,
@@ -328,17 +359,21 @@ class Client(FSM):
         return pkt['stat']
 
     async def delete(self, path: str, version: int) -> None:
+        self._check_path(path)
+        self._check_version(version)
         conn = self._conn_or_raise()
         await conn.request({'opcode': 'DELETE', 'path': path,
                             'version': version}).as_future()
 
     async def stat(self, path: str) -> Stat:
+        self._check_path(path)
         conn = self._conn_or_raise()
         pkt = await conn.request({'opcode': 'EXISTS', 'path': path,
                                   'watch': False}).as_future()
         return pkt['stat']
 
     async def get_acl(self, path: str):
+        self._check_path(path)
         conn = self._conn_or_raise()
         pkt = await conn.request({'opcode': 'GET_ACL',
                                   'path': path}).as_future()
@@ -347,8 +382,14 @@ class Client(FSM):
     async def sync(self, path: str) -> None:
         """Flush the leader pipeline to the connected server
         (reference: lib/client.js:578-597)."""
+        self._check_path(path)
         conn = self._conn_or_raise()
         await conn.request({'opcode': 'SYNC', 'path': path}).as_future()
 
     def watcher(self, path: str) -> ZKWatcher:
-        return self.get_session().watcher(path)
+        self._check_path(path)
+        sess = self.get_session()
+        if sess is None:
+            # The client is closing or closed.
+            raise ZKNotConnectedError()
+        return sess.watcher(path)

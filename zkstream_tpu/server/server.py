@@ -59,6 +59,10 @@ class ServerConnection:
 
     def _reply(self, xid: int, opcode: str, err: str = 'OK',
                **body) -> None:
+        if self.server.drop_replies:
+            return
+        if self.server.drop_pings and opcode == 'PING':
+            return
         pkt = {'xid': xid, 'zxid': self.db.zxid, 'err': err,
                'opcode': opcode}
         pkt.update(body)
@@ -309,6 +313,11 @@ class ZKServer:
         self.port = port
         self._server: asyncio.base_events.Server | None = None
         self.conns: set[ServerConnection] = set()
+        #: Fault-injection knobs for tests: swallow pings (forces the
+        #: client's ping-timeout path) or swallow every reply (forces
+        #: in-flight requests to hang until teardown).
+        self.drop_pings = False
+        self.drop_replies = False
 
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(
