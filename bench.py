@@ -1,0 +1,123 @@
+"""Benchmark: batched wire-decode throughput, TPU data plane vs scalar codec.
+
+The reference publishes no benchmark numbers (BASELINE.md — no
+benchmarks/ dir, README is API docs only), so the measurable baseline
+is defined here: decode a fleet of framed ZooKeeper reply streams —
+frame slicing + reply-header parse + xid routing + max-zxid session
+reduction, exactly the per-connection hot path of
+lib/zk-streams.js:39-99 / lib/connection-fsm.js:213-229 — and compare
+
+  baseline:  the scalar bytes-loop codec (zkstream_tpu.protocol), the
+             same implementation idiom as the reference's JavaScript
+             (per-byte buffer walking on one core), and
+  value:     the batched tensor pipeline (zkstream_tpu.ops) on the
+             default JAX device (TPU under the driver).
+
+Prints ONE JSON line:
+  {"metric": "wire_decode_throughput", "value": <MiB/s>,
+   "unit": "MiB/s", "vs_baseline": <tpu/scalar ratio>}
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import sys
+import time
+
+import numpy as np
+
+B = 256          # streams (connections) per tick
+FRAMES = 48      # frames per stream
+BODY = 84        # body bytes per frame -> 104-byte frames
+REPEATS = 30
+
+
+def _fleet():
+    rng = np.random.RandomState(42)
+    frame_len = 4 + 16 + BODY
+    L = FRAMES * frame_len
+    buf = np.zeros((B, L), np.uint8)
+    streams = []
+    for i in range(B):
+        s = b''
+        for _ in range(FRAMES):
+            xid = int(rng.randint(1, 1 << 20))
+            zxid = int(rng.randint(1, 1 << 40))
+            body = bytes(rng.randint(0, 256, BODY, dtype=np.uint8))
+            hdr = struct.pack('>iqi', xid, zxid, 0)
+            s += struct.pack('>i', len(hdr) + len(body)) + hdr + body
+        buf[i] = np.frombuffer(s, np.uint8)
+        streams.append(s)
+    lens = np.full((B,), L, np.int32)
+    return buf, lens, streams
+
+
+def bench_scalar(streams) -> float:
+    """Scalar codec MiB/s: framing + header parse + routing counts +
+    max-zxid tracking per stream, pure python like the reference's JS."""
+    from zkstream_tpu.protocol.framing import FrameDecoder
+
+    hdr = struct.Struct('>iqi')
+    total = sum(len(s) for s in streams)
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        for s in streams:
+            dec = FrameDecoder()
+            max_zxid = 0
+            n_notif = n_ping = n_err = 0
+            for body in dec.feed(s):
+                xid, zxid, err = hdr.unpack_from(body, 0)
+                if xid == -1:
+                    n_notif += 1
+                elif xid == -2:
+                    n_ping += 1
+                else:
+                    if err:
+                        n_err += 1
+                    if zxid > max_zxid:
+                        max_zxid = zxid
+    dt = time.perf_counter() - t0
+    return total * reps / dt / (1024 * 1024)
+
+
+def bench_tensor(buf, lens) -> float:
+    """Tensor pipeline MiB/s on the default JAX device."""
+    import jax
+    import jax.numpy as jnp
+
+    from zkstream_tpu.ops.pipeline import wire_pipeline_step
+
+    step = jax.jit(lambda b, l: wire_pipeline_step(
+        b, l, max_frames=FRAMES))
+    jb, jl = jnp.asarray(buf), jnp.asarray(lens)
+    out = step(jb, jl)  # compile + warm
+    jax.block_until_ready(out)
+    assert int(out.n_frames.sum()) == B * FRAMES, 'decode mismatch'
+
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = step(jb, jl)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    total = int(lens.sum())
+    return total * REPEATS / dt / (1024 * 1024)
+
+
+def main() -> None:
+    buf, lens, streams = _fleet()
+    scalar = bench_scalar(streams)
+    tensor = bench_tensor(buf, lens)
+    print(json.dumps({
+        'metric': 'wire_decode_throughput',
+        'value': round(tensor, 2),
+        'unit': 'MiB/s',
+        'vs_baseline': round(tensor / scalar, 3),
+    }))
+    print(f'# scalar baseline: {scalar:.2f} MiB/s over {B} streams x '
+          f'{FRAMES} frames', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -11,11 +11,11 @@ import os
 
 import pytest
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
-_flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in _flags:
-    os.environ['XLA_FLAGS'] = (
-        _flags + ' --xla_force_host_platform_device_count=8').strip()
+# Force CPU: the ambient environment points JAX at a remote TPU (a
+# pre-registered PJRT plugin), which must not be touched by unit tests.
+from zkstream_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_devices=8)
 
 
 # -- minimal async-test support (pytest-asyncio is not in the image) --

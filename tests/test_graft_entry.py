@@ -1,0 +1,29 @@
+"""Driver-contract tests: entry() compiles, dryrun_multichip runs."""
+
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip('jax')
+
+
+def test_entry_compiles_and_runs():
+    sys.path.insert(0, '/root/repo')
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert int(out.n_frames.sum()) > 0
+    assert not bool(out.bad.any())
+
+
+def test_dryrun_multichip_subprocess():
+    # own process: dryrun must win the platform race before backend init
+    r = subprocess.run(
+        [sys.executable, '-c',
+         'import sys; sys.path.insert(0, "/root/repo"); '
+         'import __graft_entry__ as ge; ge.dryrun_multichip(8)'],
+        capture_output=True, text=True, timeout=600, cwd='/root/repo')
+    assert r.returncode == 0, r.stderr
+    assert 'OK' in r.stdout

@@ -1,0 +1,100 @@
+"""Batched reply-header parse and per-stream session reductions.
+
+Every steady-state reply starts with a 16-byte header — xid:int32,
+zxid:int64, err:int32 (reference: lib/zk-buffer.js:275-331) — and the
+connected-state drain loop routes each packet on its xid: NOTIFICATION
+(-1) to the watcher engine, PING (-2) to the keepalive, SET_WATCHES
+(-8), AUTH (-4), and everything else to the pending-request table
+(lib/connection-fsm.js:213-229, xid table lib/zk-consts.js:135-138).
+The session separately tracks the largest zxid seen across all replies
+— its resume checkpoint (lib/zk-session.js:229-235).
+
+Here the whole drain is one vectorized pass: parse all headers of all
+streams, classify by xid with compare masks, and reduce max-zxid per
+stream with an unsigned-64 pairwise max.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .bytesops import be_i32_at, be_i64pair_at, u64pair_reduce_max
+
+XID_NOTIFICATION = -1
+XID_PING = -2
+XID_AUTH = -4
+XID_SET_WATCHES = -8
+
+
+def parse_reply_headers(buf, starts, sizes=None):
+    """Parse reply headers at each frame start.
+
+    Args:
+      buf: uint8 [B, L] stream bytes.
+      starts: int32 [B, F] frame body offsets (-1 = no frame), as
+        produced by :func:`frame_cursor_scan`.
+      sizes: int32 [B, F] frame body lengths; when given, frames
+        shorter than the 16-byte reply header are excluded from
+        ``valid`` (and surfaced via ``short``) instead of reading
+        bytes belonging to the next frame — the scalar codec raises
+        BAD_DECODE on such frames.
+
+    Returns dict of int32 [B, F] arrays: ``xid``, ``zxid_hi``,
+    ``zxid_lo``, ``err`` — values are 0 where ``valid`` is False —
+    plus bool masks ``valid`` and ``short``.
+    """
+    valid = starts >= 0
+    short = valid & (sizes < 16) if sizes is not None else (
+        jnp.zeros_like(valid))
+    valid = valid & ~short
+    off = jnp.where(valid, starts, 0)
+    xid = jnp.where(valid, be_i32_at(buf, off), 0)
+    zh, zl = be_i64pair_at(buf, off + 4)
+    err = be_i32_at(buf, off + 12)
+    return {
+        'valid': valid,
+        'short': short,
+        'xid': xid,
+        'zxid_hi': jnp.where(valid, zh, 0),
+        'zxid_lo': jnp.where(valid, zl, 0),
+        'err': jnp.where(valid, err, 0),
+    }
+
+
+def stream_stats(headers):
+    """Per-stream reductions over parsed headers.
+
+    Mirrors what one pass of the drain loop accumulates: reply/
+    notification/ping routing counts and the max zxid for the session
+    checkpoint.  Notifications carry zxid -1 on the wire and must not
+    advance the checkpoint — the valid mask plus xid>=0 filter handles
+    that (reference: lib/zk-session.js:229-235 only advances on
+    positive zxids).
+
+    Returns dict of int32 [B] arrays: ``n_replies``, ``n_notifications``,
+    ``n_pings``, ``n_errors``, ``max_zxid_hi``, ``max_zxid_lo``.
+    """
+    valid = headers['valid']
+    xid = headers['xid']
+    err = headers['err']
+
+    def count(mask):
+        return jnp.sum((valid & mask).astype(jnp.int32), axis=1)
+
+    is_notif = xid == XID_NOTIFICATION
+    is_ping = xid == XID_PING
+    is_reply = xid >= 0
+
+    # zxid max over data replies only (masked frames contribute (0,0))
+    zh = jnp.where(valid & is_reply, headers['zxid_hi'], 0)
+    zl = jnp.where(valid & is_reply, headers['zxid_lo'], 0)
+    mh, ml = u64pair_reduce_max(zh, zl, axis=1)
+
+    return {
+        'n_replies': count(is_reply),
+        'n_notifications': count(is_notif),
+        'n_pings': count(is_ping),
+        'n_errors': count(is_reply & (err != 0)),
+        'max_zxid_hi': mh,
+        'max_zxid_lo': ml,
+    }

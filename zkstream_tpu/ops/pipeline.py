@@ -1,0 +1,72 @@
+"""The flagship jittable step: batched wire decode for a stream fleet.
+
+One call = one "network tick" for B connections: slice every complete
+frame out of every stream, parse every reply header, route by xid, and
+reduce the per-stream session checkpoints — the vectorized equivalent
+of running the reference's decode loop (lib/zk-streams.js:39-99) and
+connected-state drain (lib/connection-fsm.js:213-229) once per
+connection, but as a single fused XLA computation with static shapes.
+
+This is the unit the driver compile-checks (see __graft_entry__.py) and
+the benchmark measures (bench.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .frame_scan import frame_cursor_scan
+from .headers import parse_reply_headers, stream_stats
+
+
+class WireStats(NamedTuple):
+    """Per-stream results of one pipeline step (all shaped [B] unless
+    noted)."""
+
+    starts: jnp.ndarray        # int32 [B, F] frame body offsets, -1 pad
+    sizes: jnp.ndarray         # int32 [B, F] frame body lengths
+    xids: jnp.ndarray          # int32 [B, F] reply xids (0 where pad)
+    errs: jnp.ndarray          # int32 [B, F] reply error codes
+    n_frames: jnp.ndarray      # int32 [B]
+    n_replies: jnp.ndarray     # int32 [B]
+    n_notifications: jnp.ndarray  # int32 [B]
+    n_pings: jnp.ndarray       # int32 [B]
+    n_errors: jnp.ndarray      # int32 [B]
+    max_zxid_hi: jnp.ndarray   # int32 [B] session checkpoint, high word
+    max_zxid_lo: jnp.ndarray   # int32 [B] session checkpoint, low word
+    bad: jnp.ndarray           # bool [B] BAD_LENGTH or short-frame seen
+    resid: jnp.ndarray         # int32 [B] partial-frame cursor
+
+
+def wire_pipeline_step(buf, lens, max_frames: int = 32) -> WireStats:
+    """Decode one tick of B streams.
+
+    Args:
+      buf: uint8 [B, L] accumulated bytes per connection.
+      lens: int32 [B] valid byte counts.
+      max_frames: static per-stream frame bound for this tick.
+    """
+    starts, sizes, counts, bad, resid = frame_cursor_scan(
+        buf, lens, max_frames)
+    headers = parse_reply_headers(buf, starts, sizes)
+    stats = stream_stats(headers)
+    # a frame too short to hold the 16-byte reply header is a protocol
+    # violation (scalar codec: BAD_DECODE) — flag, don't misparse
+    bad = bad | jnp.any(headers['short'], axis=1)
+    return WireStats(
+        starts=starts,
+        sizes=sizes,
+        xids=headers['xid'],
+        errs=headers['err'],
+        n_frames=counts,
+        n_replies=stats['n_replies'],
+        n_notifications=stats['n_notifications'],
+        n_pings=stats['n_pings'],
+        n_errors=stats['n_errors'],
+        max_zxid_hi=stats['max_zxid_hi'],
+        max_zxid_lo=stats['max_zxid_lo'],
+        bad=bad,
+        resid=resid,
+    )

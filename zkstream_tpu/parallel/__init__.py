@@ -1,0 +1,22 @@
+"""Distributed data plane: mesh construction and sharded wire decode.
+
+The reference's "distributed" machinery is a client-side ensemble pool
+over raw TCP (lib/client.js:88-118) — there are no collectives to
+translate.  What *does* shard on a TPU pod is the data plane built in
+:mod:`zkstream_tpu.ops`: a fleet of connection streams decodes
+data-parallel over a device mesh, global session statistics reduce with
+``psum``/``pmax`` over ICI, and a single long stream can be scanned
+sequence-parallel along its byte axis with a ``ppermute`` ring carrying
+the frame cursor across shard boundaries.
+
+- :mod:`mesh` — mesh construction helpers (dp × sp axes).
+- :mod:`sharded` — ``shard_map`` batched decode + collective reductions.
+- :mod:`seqscan` — byte-axis sequence-parallel frame scan (ring
+  cursor hand-off via ``ppermute``).
+"""
+
+from .mesh import make_mesh
+from .sharded import sharded_wire_step
+from .seqscan import seq_parallel_frame_scan
+
+__all__ = ['make_mesh', 'sharded_wire_step', 'seq_parallel_frame_scan']

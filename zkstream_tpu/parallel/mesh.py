@@ -1,0 +1,33 @@
+"""Device-mesh construction helpers.
+
+A 2-D ``dp × sp`` mesh covers this framework's parallelism needs:
+``dp`` shards the connection-stream batch (data parallel), ``sp``
+shards the byte axis of long streams (sequence parallel).  Axes of
+size 1 are always present so the same ``PartitionSpec``s work at any
+scale — single chip through pod slice.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(dp: int | None = None, sp: int = 1, devices=None) -> Mesh:
+    """Build a ``(dp, sp)`` mesh over ``devices`` (default: all).
+
+    With ``dp=None`` the data-parallel axis absorbs every device not
+    used by ``sp``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        if n % sp:
+            raise ValueError(f'{n} devices not divisible by sp={sp}')
+        dp = n // sp
+    if dp * sp != n:
+        raise ValueError(f'dp*sp = {dp * sp} != {n} devices')
+    arr = np.asarray(devices).reshape(dp, sp)
+    return Mesh(arr, ('dp', 'sp'))

@@ -1,0 +1,92 @@
+"""Data-parallel sharded wire decode with collective reductions.
+
+Shards the [B, L] stream batch across the mesh's ``dp`` axis with
+``shard_map``; each device runs the local :func:`wire_pipeline_step`
+and the global session summary (total frames/notifications, fleet-wide
+max zxid) reduces over ICI with ``psum`` / unsigned-64 ``pmax`` on
+(hi, lo) pairs.  The fleet-wide max zxid is what a multi-host session
+manager would persist as its resume checkpoint — the distributed
+analogue of lib/zk-session.js:229-235.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.bytesops import u64pair_reduce_max
+from ..ops.pipeline import WireStats, wire_pipeline_step
+
+
+class GlobalWireStats(NamedTuple):
+    """Fleet-wide reductions (replicated scalars)."""
+
+    total_frames: jnp.ndarray
+    total_notifications: jnp.ndarray
+    total_errors: jnp.ndarray
+    max_zxid_hi: jnp.ndarray
+    max_zxid_lo: jnp.ndarray
+
+
+_SIGN = -0x80000000
+
+
+def _u64_axis_max(h, l, axis_name):
+    """Unsigned 64-bit max of a (hi, lo) int32 scalar pair across a
+    mesh axis, without 64-bit lanes: flip signs so signed pmax orders
+    like unsigned, take pmax of hi, then pmax of lo among the winners."""
+    sign = jnp.int32(_SIGN)
+    uh = h ^ sign
+    mh = lax.pmax(uh, axis_name)
+    lo_key = jnp.where(uh == mh, l ^ sign, sign)
+    ml = lax.pmax(lo_key, axis_name)
+    return mh ^ sign, ml ^ sign
+
+
+def sharded_wire_step(mesh: Mesh, max_frames: int = 32):
+    """Build the jitted dp-sharded pipeline step for ``mesh``.
+
+    Returns a function ``step(buf, lens) -> (WireStats, GlobalWireStats)``
+    where ``buf`` is uint8 [B, L] with B divisible by the dp axis size;
+    per-stream outputs stay dp-sharded, global stats are replicated.
+    """
+
+    def local_step(buf, lens):
+        stats = wire_pipeline_step(buf, lens, max_frames=max_frames)
+        # local lexicographic zxid winner, then the cross-device
+        # unsigned-64 pmax over the dp axis
+        lh, ll = u64pair_reduce_max(stats.max_zxid_hi, stats.max_zxid_lo)
+        gh, gl = _u64_axis_max(lh, ll, 'dp')
+        g = GlobalWireStats(
+            total_frames=lax.psum(jnp.sum(stats.n_frames), 'dp'),
+            total_notifications=lax.psum(
+                jnp.sum(stats.n_notifications), 'dp'),
+            total_errors=lax.psum(jnp.sum(stats.n_errors), 'dp'),
+            max_zxid_hi=gh,
+            max_zxid_lo=gl,
+        )
+        return stats, g
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P('dp', None), P('dp')),
+        out_specs=(
+            WireStats(
+                starts=P('dp', None), sizes=P('dp', None),
+                xids=P('dp', None), errs=P('dp', None),
+                n_frames=P('dp'), n_replies=P('dp'),
+                n_notifications=P('dp'), n_pings=P('dp'),
+                n_errors=P('dp'), max_zxid_hi=P('dp'),
+                max_zxid_lo=P('dp'), bad=P('dp'), resid=P('dp'),
+            ),
+            GlobalWireStats(P(), P(), P(), P(), P()),
+        ),
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,46 @@
+"""Host-platform control for tests and multi-chip dry runs.
+
+The deployment image boots every interpreter with a remote-TPU PJRT
+plugin pre-registered (a site hook driven by ``PALLAS_AXON_POOL_IPS``)
+and pins ``jax_platforms`` to prefer it.  Unit tests and the virtual
+multi-chip dry run must instead run on N in-process CPU devices —
+touching the remote chip from dozens of tests would be slow at best.
+``force_cpu`` re-points JAX at the CPU backend even after the hook has
+run: it must be called before the first backend initialization (first
+``jax.devices()`` / first traced op) in the process.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin this process's JAX to the host CPU platform.
+
+    With ``n_devices``, also request that many virtual CPU devices
+    (``--xla_force_host_platform_device_count``) — only effective if
+    the CPU backend has not been initialized yet.
+    """
+    if n_devices is not None:
+        flags = os.environ.get('XLA_FLAGS', '')
+        flag = f'--xla_force_host_platform_device_count={n_devices}'
+        if '--xla_force_host_platform_device_count' in flags:
+            flags = ' '.join(
+                flag if f.startswith('--xla_force_host_platform_device_count')
+                else f for f in flags.split())
+        else:
+            flags = (flags + ' ' + flag).strip()
+        os.environ['XLA_FLAGS'] = flags
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+
+    import jax
+
+    jax.config.update('jax_platforms', 'cpu')
+    try:  # drop the remote plugin's factory so backend discovery
+        # cannot stall dialing a TPU the tests must not touch
+        from jax._src import xla_bridge as xb
+
+        xb._backend_factories.pop('axon', None)
+    except Exception:
+        pass
