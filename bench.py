@@ -83,26 +83,50 @@ def bench_scalar(streams) -> float:
 
 
 def bench_tensor(buf, lens) -> float:
-    """Tensor pipeline MiB/s on the default JAX device."""
+    """Tensor pipeline MiB/s on the default JAX device.
+
+    Tries the fused Pallas kernel first (ops/pallas_scan.py — ~2.5x
+    the XLA scan on TPU v5e) and falls back to the pure-jnp pipeline
+    where Pallas cannot lower (e.g. plain CPU jax); both are
+    property-tested equivalent (tests/test_pallas.py)."""
     import jax
     import jax.numpy as jnp
 
-    from zkstream_tpu.ops.pipeline import wire_pipeline_step
+    from zkstream_tpu.ops.pipeline import (
+        wire_pipeline_step,
+        wire_pipeline_step_pallas,
+    )
 
-    step = jax.jit(lambda b, l: wire_pipeline_step(
-        b, l, max_frames=FRAMES))
     jb, jl = jnp.asarray(buf), jnp.asarray(lens)
-    out = step(jb, jl)  # compile + warm
-    jax.block_until_ready(out)
-    assert int(out.n_frames.sum()) == B * FRAMES, 'decode mismatch'
-
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        out = step(jb, jl)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+    candidates = [
+        ('pallas', lambda b, l: wire_pipeline_step_pallas(
+            b, l, max_frames=FRAMES, block_rows=128)),
+        ('jnp', lambda b, l: wire_pipeline_step(
+            b, l, max_frames=FRAMES)),
+    ]
+    best = 0.0
     total = int(lens.sum())
-    return total * REPEATS / dt / (1024 * 1024)
+    for name, fn in candidates:
+        try:
+            step = jax.jit(fn)
+            out = step(jb, jl)  # compile + warm
+            jax.block_until_ready(out)
+        except Exception as e:  # pallas unsupported on this backend
+            print(f'# {name} path unavailable: {e}', file=sys.stderr)
+            continue
+        # correctness gate OUTSIDE the availability-try: a decode
+        # mismatch must fail the benchmark, not skip the path
+        assert int(out.n_frames.sum()) == B * FRAMES, \
+            f'{name} decode mismatch'
+        t0 = time.perf_counter()
+        for _ in range(REPEATS):
+            out = step(jb, jl)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        mibs = total * REPEATS / dt / (1024 * 1024)
+        print(f'# {name} path: {mibs:.2f} MiB/s', file=sys.stderr)
+        best = max(best, mibs)
+    return best
 
 
 def main() -> None:

@@ -1,5 +1,6 @@
 """Driver-contract tests: entry() compiles, dryrun_multichip runs."""
 
+import pathlib
 import subprocess
 import sys
 
@@ -7,9 +8,11 @@ import pytest
 
 jax = pytest.importorskip('jax')
 
+ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
 
 def test_entry_compiles_and_runs():
-    sys.path.insert(0, '/root/repo')
+    sys.path.insert(0, ROOT)
     import __graft_entry__ as ge
 
     fn, args = ge.entry()
@@ -22,8 +25,8 @@ def test_dryrun_multichip_subprocess():
     # own process: dryrun must win the platform race before backend init
     r = subprocess.run(
         [sys.executable, '-c',
-         'import sys; sys.path.insert(0, "/root/repo"); '
+         f'import sys; sys.path.insert(0, {ROOT!r}); '
          'import __graft_entry__ as ge; ge.dryrun_multichip(8)'],
-        capture_output=True, text=True, timeout=600, cwd='/root/repo')
+        capture_output=True, text=True, timeout=600, cwd=ROOT)
     assert r.returncode == 0, r.stderr
     assert 'OK' in r.stdout

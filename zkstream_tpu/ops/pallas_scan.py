@@ -1,0 +1,187 @@
+"""Fused Pallas TPU kernel for the wire-decode hot path.
+
+One kernel invocation = frame scan + reply-header parse for a block of
+connection streams, entirely in VMEM.  This fuses what
+:mod:`zkstream_tpu.ops.frame_scan` and :mod:`zkstream_tpu.ops.headers`
+express as separate XLA ops (a ``lax.scan`` whose every step re-gathers
+from the HBM-resident buffer, then a second gather pass for headers)
+into a single pass: the byte block is staged into VMEM once, and the
+per-frame cursor walk plus all five header-field reads run on-chip as
+weighted lane-reduces — each 4-byte window gets big-endian place
+values (1 << 8*(3-d)) and a row-sum assembles the word.  That is the
+VPU-shaped formulation of a per-row dynamic gather, which Mosaic has
+no native vector instruction for.
+
+Semantics match ``frame_cursor_scan`` + ``parse_reply_headers`` exactly
+(property-tested against them in tests/test_pallas.py); both re-state
+the reference's sequential decode loop, lib/zk-streams.js:39-99, and
+drain-loop routing, lib/connection-fsm.js:213-229, as array code.
+
+Grid: one program per row-block, ``dimension_semantics=("parallel",)``
+so Megacore splits blocks across TensorCores.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..protocol.consts import MAX_PACKET
+
+# Header field offsets relative to the frame's length prefix: the body
+# begins at +4 with xid:int32, zxid:int64 (as hi/lo words), err:int32
+# (reference: lib/zk-buffer.js:275-331).
+_LEN_OFF = 0
+_XID_OFF = 4
+_ZHI_OFF = 8
+_ZLO_OFF = 12
+_ERR_OFF = 16
+# widest read starts at cur + 16 and spans 4 bytes -> need 20 bytes of
+# zero padding past the last valid position so speculative reads of
+# masked-off lanes stay in bounds
+_PAD = 20
+
+
+def _kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
+            zhi_ref, zlo_ref, err_ref, resid_ref, bad_ref,
+            *, max_frames: int):
+    """Scan one [R, Lp] uint8 block; emit [F, R] frame/header planes."""
+    R, Lp = buf_ref.shape
+
+    b = buf_ref[:].astype(jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
+    n = len_ref[:]  # [R, 1]
+
+    def gather(cur, off):
+        """BE int32 at byte offset cur[r]+off per row, as one weighted
+        lane-reduce: each lane in the 4-byte window gets its big-endian
+        place value (1 << 8*(3-d)) and the row sum assembles the word —
+        non-overlapping bit planes, so wrapping int32 adds reproduce
+        the signed bit pattern exactly (the vectorized restatement of
+        lib/jute-buffer.js:102-106, formulated without lane-shifted
+        slices, which Mosaic miscompiles as of jax 0.9)."""
+        d = lane - (cur + off)
+        in_win = (d >= 0) & (d < 4)
+        w = jnp.where(in_win,
+                      jnp.int32(1) << jnp.where(in_win, 8 * (3 - d), 0),
+                      0)
+        return jnp.sum(b * w, axis=1, keepdims=True)
+
+    def step(j, carry):
+        cur, bad = carry  # bad is int32 0/1 (Mosaic-friendly carry)
+        has_prefix = cur + 4 <= n
+        ln = jnp.where(has_prefix, gather(cur, _LEN_OFF), 0)
+        is_bad = has_prefix & ((ln < 0) | (ln > MAX_PACKET))
+        complete = (has_prefix & ~is_bad & (bad == 0)
+                    & (cur + 4 + ln <= n))
+        start = jnp.where(complete, cur + 4, -1)
+        size = jnp.where(complete, ln, 0)
+        # header fields only exist when the body holds the full
+        # 16-byte reply header; shorter complete frames are protocol
+        # violations surfaced via size (pipeline flags them as short)
+        hdr_ok = complete & (ln >= 16)
+        xid = jnp.where(hdr_ok, gather(cur, _XID_OFF), 0)
+        zhi = jnp.where(hdr_ok, gather(cur, _ZHI_OFF), 0)
+        zlo = jnp.where(hdr_ok, gather(cur, _ZLO_OFF), 0)
+        err = jnp.where(hdr_ok, gather(cur, _ERR_OFF), 0)
+
+        row = pl.ds(j, 1)
+        starts_ref[row, :] = start.reshape(1, R)
+        sizes_ref[row, :] = size.reshape(1, R)
+        xid_ref[row, :] = xid.reshape(1, R)
+        zhi_ref[row, :] = zhi.reshape(1, R)
+        zlo_ref[row, :] = zlo.reshape(1, R)
+        err_ref[row, :] = err.reshape(1, R)
+        return (jnp.where(complete, cur + 4 + ln, cur),
+                bad | is_bad.astype(jnp.int32))
+
+    cur0 = jnp.zeros((R, 1), jnp.int32)
+    bad0 = jnp.zeros((R, 1), jnp.int32)
+    cur, bad = jax.lax.fori_loop(0, max_frames, step, (cur0, bad0))
+    resid_ref[0, :] = cur.reshape(R)
+    bad_ref[0, :] = bad.reshape(R)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(
+    jax.jit, static_argnames=('max_frames', 'block_rows', 'interpret'))
+def pallas_wire_scan(buf, lens, max_frames: int = 32,
+                     block_rows: int = 64, interpret: bool = False):
+    """Fused frame scan + header parse on TPU via Pallas.
+
+    Args:
+      buf: uint8 [B, L] accumulated bytes per connection.
+      lens: int32 [B] valid byte counts.
+      max_frames: static per-stream frame bound.
+      block_rows: streams per kernel program (grid = B / block_rows).
+      interpret: run in the Pallas interpreter (for CPU-based tests).
+
+    Returns:
+      dict with int32 [B, F] planes ``starts``, ``sizes``, ``xid``,
+      ``zxid_hi``, ``zxid_lo``, ``err``; int32 [B] ``counts`` and
+      ``resid``; bool [B] ``bad`` — field-for-field the outputs of
+      ``frame_cursor_scan`` + ``parse_reply_headers``.
+    """
+    B, L = buf.shape
+    # Mosaic tiling: the [F, R] output blocks put rows on the lane
+    # axis, so a multi-block grid needs R % 128 == 0; a single block
+    # spanning the whole (padded) batch is exempt.
+    if interpret:
+        R = min(block_rows, _round_up(B, 8))
+        Bp = _round_up(B, R)
+    elif B <= block_rows:
+        R = Bp = _round_up(B, 8)
+    else:
+        R = _round_up(block_rows, 128)
+        Bp = _round_up(B, R)
+    Lp = _round_up(L + _PAD, 128)
+
+    buf = jnp.zeros((Bp, Lp), jnp.uint8).at[:B, :L].set(buf)
+    lens = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(
+        lens.astype(jnp.int32))
+
+    kern = functools.partial(_kernel, max_frames=max_frames)
+    plane = jax.ShapeDtypeStruct((max_frames, Bp), jnp.int32)
+    rowvec = jax.ShapeDtypeStruct((1, Bp), jnp.int32)
+    grid = (Bp // R,)
+    in_specs = [
+        pl.BlockSpec((R, Lp), lambda i: (i, 0)),
+        pl.BlockSpec((R, 1), lambda i: (i, 0)),
+    ]
+    plane_spec = pl.BlockSpec((max_frames, R), lambda i: (0, i))
+    row_spec = pl.BlockSpec((1, R), lambda i: (0, i))
+
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(plane_spec,) * 6 + (row_spec, row_spec),
+        out_shape=(plane,) * 6 + (rowvec, rowvec),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel',)),
+        interpret=interpret,
+    )(buf, lens)
+    starts, sizes, xid, zhi, zlo, err, resid, bad = out
+
+    def unpad(p):
+        return jnp.moveaxis(p, 0, 1)[:B]
+
+    starts = unpad(starts)
+    return {
+        'starts': starts,
+        'sizes': unpad(sizes),
+        'xid': unpad(xid),
+        'zxid_hi': unpad(zhi),
+        'zxid_lo': unpad(zlo),
+        'err': unpad(err),
+        'counts': jnp.sum((starts >= 0).astype(jnp.int32), axis=1),
+        'resid': resid[0, :B],
+        'bad': bad[0, :B].astype(jnp.bool_),
+    }

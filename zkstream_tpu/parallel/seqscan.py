@@ -98,18 +98,39 @@ def seq_parallel_frame_scan(mesh: Mesh):
 
         valid = idx == 0
         entry = base * 0
+        C_local = buf.shape[0]
+
+        def walk_from(e):
+            return _walk(ext, base, chunk_end, n, e)
+
+        def keep(state):
+            def f(_):
+                return state
+            return f
+
+        # Each shard walks its chunk EXACTLY once — when it learns its
+        # entry cursor (shard 0 at init, others on adopt) — and carries
+        # the resulting (exit, mask, bad) through the ring.  Shards
+        # whose turn hasn't come skip the walk via lax.cond (a real
+        # branch per device under shard_map, not a select).
+        zero_state = (jnp.int32(-1) + base * 0,
+                      jnp.zeros((C_local,), jnp.bool_) | (base < 0),
+                      base < 0)
+        state = lax.cond(valid, walk_from, keep(zero_state), entry)
 
         def ring_step(carry, _):
-            valid, entry = carry
-            exit_q, _, _ = _walk(ext, base, chunk_end, n, entry)
+            valid, entry, state = carry
+            exit_q = state[0]
             snd = jnp.where(valid, exit_q, -1)
             rcv = lax.ppermute(snd, 'sp', fwd)
             adopt = ~valid & (rcv >= 0)
-            return (valid | adopt, jnp.where(adopt, rcv, entry)), None
+            entry = jnp.where(adopt, rcv, entry)
+            state = lax.cond(adopt, walk_from, keep(state), entry)
+            return (valid | adopt, entry, state), None
 
-        (valid, entry), _ = lax.scan(
-            ring_step, (valid, entry), None, length=max(p - 1, 1))
-        _, mask, bad = _walk(ext, base, chunk_end, n, entry)
+        (valid, entry, state), _ = lax.scan(
+            ring_step, (valid, entry, state), None, length=max(p - 1, 1))
+        _, mask, bad = state
         total = lax.psum(jnp.sum(mask.astype(jnp.int32)), 'sp')
         any_bad = lax.psum(bad.astype(jnp.int32), 'sp') > 0
         return mask, total, any_bad
