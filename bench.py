@@ -64,7 +64,9 @@ def bench_scalar(streams) -> float:
     reps = 3
     for _ in range(reps):
         for s in streams:
-            dec = FrameDecoder()
+            # use_native=False: the baseline is the reference-idiom
+            # interpreted scalar loop, not the C++ host codec
+            dec = FrameDecoder(use_native=False)
             max_zxid = 0
             n_notif = n_ping = n_err = 0
             for body in dec.feed(s):

@@ -1,0 +1,101 @@
+"""A/B tests: native C++ frame scanner vs the pure-Python spec.
+
+The Python loop in FrameDecoder.feed is the semantic reference; the
+native path (native/zkwire.cpp via ctypes) must match it on every
+stream, chunking, and error case.  Skipped wholesale when no toolchain
+can produce libzkwire.so.
+"""
+
+import random
+import struct
+
+import pytest
+
+from zkstream_tpu.protocol.consts import MAX_PACKET
+from zkstream_tpu.protocol.errors import ZKProtocolError
+from zkstream_tpu.protocol.framing import FrameDecoder
+from zkstream_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    native.ensure_lib() is None, reason='native codec unavailable')
+
+
+def _frames_blob(rng, n, max_body=64):
+    bodies = [bytes(rng.randrange(0, 256)
+                    for _ in range(rng.randrange(0, max_body)))
+              for _ in range(n)]
+    blob = b''.join(struct.pack('>i', len(b)) + b for b in bodies)
+    return bodies, blob
+
+
+def _feed_chunked(dec, blob, rng, max_chunk):
+    out, i = [], 0
+    while i < len(blob):
+        step = rng.randrange(1, max_chunk + 1)
+        out += dec.feed(blob[i:i + step])
+        i += step
+    return out
+
+
+@pytest.mark.parametrize('seed', [0, 1, 2, 3])
+def test_native_matches_python_random_streams(seed):
+    rng = random.Random(seed)
+    bodies, blob = _frames_blob(rng, rng.randrange(1, 40))
+    for max_chunk in (1, 3, 7, len(blob) or 1):
+        py = _feed_chunked(FrameDecoder(use_native=False), blob,
+                           random.Random(seed), max_chunk)
+        nat = _feed_chunked(FrameDecoder(use_native=True), blob,
+                            random.Random(seed), max_chunk)
+        assert py == nat == bodies
+
+
+def test_native_bad_length_contract():
+    good = struct.pack('>i', 4) + b'abcd'
+    bad = struct.pack('>i', -5)
+    py, nat = (FrameDecoder(use_native=False),
+               FrameDecoder(use_native=True))
+    for dec in (py, nat):
+        with pytest.raises(ZKProtocolError) as ei:
+            dec.feed(good + bad)
+        assert ei.value.code == 'BAD_LENGTH'
+    # both leave the buffer positioned at the offending prefix
+    assert py.pending() == nat.pending() == len(bad)
+
+
+def test_native_oversize_length():
+    blob = struct.pack('>i', MAX_PACKET + 1) + b'\0' * 16
+    dec = FrameDecoder(use_native=True)
+    with pytest.raises(ZKProtocolError) as ei:
+        dec.feed(blob)
+    assert ei.value.code == 'BAD_LENGTH'
+
+
+def test_native_partial_then_complete():
+    body = b'\x55' * 1000
+    blob = struct.pack('>i', len(body)) + body
+    dec = FrameDecoder(use_native=True)
+    assert dec.feed(blob[:500]) == []
+    assert dec.pending() == 500
+    assert dec.feed(blob[500:]) == [body]
+    assert dec.pending() == 0
+
+
+def test_native_many_frames_exceeding_scan_cap():
+    """More frames in one feed than the per-call native cap (256)."""
+    rng = random.Random(9)
+    bodies, blob = _frames_blob(rng, 700, max_body=8)
+    out = FrameDecoder(use_native=True).feed(blob)
+    assert out == bodies
+
+
+def test_native_large_frame_incremental_chunks():
+    """A large frame arriving in socket-sized chunks must reassemble
+    (and must not choke on the zero-copy buffer export)."""
+    body = bytes(range(256)) * 2048  # 512 KiB
+    blob = struct.pack('>i', len(body)) + body
+    dec = FrameDecoder(use_native=True)
+    out = []
+    for i in range(0, len(blob), 65536):
+        out += dec.feed(blob[i:i + 65536])
+    assert out == [body]
+    assert dec.pending() == 0

@@ -28,18 +28,39 @@ _LEN = struct.Struct('>i')
 
 
 class FrameDecoder:
-    """Incremental splitter of a byte stream into length-prefixed frames."""
+    """Incremental splitter of a byte stream into length-prefixed frames.
 
-    __slots__ = ('_buf',)
+    When the native host codec is available (native/zkwire.cpp, loaded
+    via utils/native.py) the scan runs in C++; the pure-Python loop is
+    the always-present fallback and the semantic spec — the two are
+    A/B-tested equivalent in tests/test_native.py.  ``use_native=None``
+    auto-detects; True/False force a path (tests, benchmarks).
+    """
 
-    def __init__(self) -> None:
+    __slots__ = ('_buf', '_scanner')
+
+    def __init__(self, use_native: bool | None = None) -> None:
         self._buf = bytearray()
+        self._scanner = None
+        if use_native is not False:
+            from ..utils import native
+            # auto mode (None) must never block the event loop: it
+            # binds only an already-built artifact (the build proceeds
+            # on a background thread for later connections).  Forced
+            # mode (True, tests/tools) builds synchronously.
+            lib = native.ensure_lib() if use_native else native.get_lib()
+            if lib is not None:
+                self._scanner = native.NativeFrameScanner(lib)
+            elif use_native is True:
+                raise RuntimeError('native codec unavailable')
 
     def feed(self, chunk: bytes) -> list[bytes]:
         """Absorb ``chunk``; return every complete frame body now
         available.  Raises ZKProtocolError('BAD_LENGTH') on a negative or
         oversized length prefix (reference: lib/zk-streams.js:47-53)."""
         self._buf += chunk
+        if self._scanner is not None:
+            return self._feed_native()
         frames: list[bytes] = []
         off = 0
         try:
@@ -55,6 +76,24 @@ class FrameDecoder:
         finally:
             if off:
                 del self._buf[:off]
+        return frames
+
+    def _feed_native(self) -> list[bytes]:
+        """Native scan over the accumulated buffer (zero-copy: the
+        scanner reads the bytearray in place).  Matches the Python loop
+        exactly, including the BAD_LENGTH contract: complete frames
+        before an invalid prefix are consumed-and-discarded and the
+        buffer is left positioned at the offending prefix."""
+        spans, resid, bad_at = self._scanner.scan(self._buf, MAX_PACKET)
+        if bad_at is not None:
+            if bad_at:
+                del self._buf[:bad_at]
+            (ln,) = _LEN.unpack_from(self._buf, 0)
+            raise ZKProtocolError('BAD_LENGTH',
+                'Invalid ZK packet length %d' % (ln,))
+        frames = [bytes(self._buf[s:s + z]) for s, z in spans]
+        if resid:
+            del self._buf[:resid]
         return frames
 
     def pending(self) -> int:

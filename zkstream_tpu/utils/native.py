@@ -1,0 +1,185 @@
+"""Loader for the native host codec (native/zkwire.cpp).
+
+Builds the shared library on first use with the ambient ``g++`` and
+binds it via ctypes.  Design constraints, in order:
+
+- **Never block the event loop.**  ``get_lib()`` only dlopens an
+  already-built artifact; when a build is needed it is kicked off on a
+  daemon thread and ``get_lib()`` returns None until it lands, so the
+  connection path silently runs pure-Python in the meantime.
+- **Stale artifacts can't poison the process.**  The artifact name
+  embeds the ABI version (``libzkwire.v1.so``); an old build is simply
+  a different filename that is never dlopened, sidestepping glibc's
+  same-path handle caching.
+- **Graceful degradation.**  No compiler, failed build, failed load →
+  None, and callers keep the pure-Python implementations — mirroring
+  how the reference runs on nothing but the OS TCP stack (SURVEY.md §2:
+  zero native components required).
+
+``ZKSTREAM_NO_NATIVE=1`` forces the pure-Python path (tests A/B the two
+implementations with it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger('zkstream_tpu.native')
+
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+_builder: threading.Thread | None = None
+
+
+def _root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def source_path() -> str:
+    return os.path.join(_root(), 'native', 'zkwire.cpp')
+
+
+def lib_path() -> str:
+    return os.path.join(_root(), 'native',
+                        'libzkwire.v%d.so' % _ABI_VERSION)
+
+
+def build() -> str | None:
+    """Compile the library if missing or stale; return its path or
+    None.  Synchronous — call from tests/tools, not the event loop
+    (:func:`get_lib` wraps it in a background thread)."""
+    src, out = source_path(), lib_path()
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + '.tmp.%d' % os.getpid()
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', src, '-o', tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info('native build unavailable: %s', e)
+        return None
+    if r.returncode != 0:
+        log.warning('native build failed: %s', r.stderr.strip())
+        return None
+    os.replace(tmp, out)  # atomic: concurrent builders can't mix halves
+    return out
+
+
+def _bind(path: str) -> ctypes.CDLL | None:
+    lib = ctypes.CDLL(path)
+    lib.zkwire_abi_version.restype = ctypes.c_int32
+    lib.zkwire_abi_version.argtypes = []
+    if lib.zkwire_abi_version() != _ABI_VERSION:
+        log.warning('libzkwire ABI mismatch (version-named artifact '
+                    'should make this impossible)')
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.zkwire_frame_scan.restype = ctypes.c_int32
+    lib.zkwire_frame_scan.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p]
+    return lib
+
+
+def _try_load() -> None:
+    """Bind the on-disk artifact if present and current (fast: one
+    stat + dlopen).  Sets _lib/_load_failed; caller holds _lock."""
+    global _lib, _load_failed
+    out, src = lib_path(), source_path()
+    if not (os.path.exists(out) and os.path.exists(src)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return
+    try:
+        _lib = _bind(out)
+    except OSError as e:
+        log.warning('libzkwire load failed: %s', e)
+        _lib = None
+    if _lib is None:
+        _load_failed = True
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The bound library, or None if unavailable (yet).
+
+    Non-blocking: when the artifact is missing the build runs on a
+    daemon thread and this returns None until a later call finds the
+    artifact ready."""
+    global _builder
+    if os.environ.get('ZKSTREAM_NO_NATIVE') == '1':
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        _try_load()
+        if _lib is not None or _load_failed:
+            return _lib
+        if _builder is None or not _builder.is_alive():
+            _builder = threading.Thread(
+                target=build, name='zkwire-build', daemon=True)
+            _builder.start()
+        return None
+
+
+def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
+    """Blocking variant for tests/tools: build synchronously and bind."""
+    if os.environ.get('ZKSTREAM_NO_NATIVE') == '1':
+        return None
+    if build() is None:
+        return None
+    return get_lib()
+
+
+class NativeFrameScanner:
+    """ctypes facade over zkwire_frame_scan for one connection.
+
+    ``scan`` reads the caller's accumulation buffer zero-copy (ctypes
+    ``from_buffer`` on the bytearray) and returns ``(spans, resid,
+    bad_at)``: (start, size) body spans, the cursor after the last
+    complete frame, and the offset of an invalid length prefix (or
+    None).  The caller must not mutate the bytearray during the call
+    (single-threaded asyncio guarantees that here)."""
+
+    __slots__ = ('_lib', '_cap', '_starts', '_sizes')
+
+    def __init__(self, lib: ctypes.CDLL, cap: int = 256):
+        self._lib = lib
+        self._cap = cap
+        self._starts = (ctypes.c_int32 * cap)()
+        self._sizes = (ctypes.c_int32 * cap)()
+
+    def scan(self, buf: bytearray, max_packet: int):
+        n_total = len(buf)
+        if n_total < 4:
+            return [], 0, None
+        arr = (ctypes.c_uint8 * n_total).from_buffer(buf)
+        try:
+            addr = ctypes.addressof(arr)
+            spans: list[tuple[int, int]] = []
+            base = 0
+            while True:
+                resid = ctypes.c_int32(0)
+                n = self._lib.zkwire_frame_scan(
+                    addr + base, n_total - base, max_packet, self._cap,
+                    self._starts, self._sizes, ctypes.byref(resid))
+                if n < 0:
+                    bad = base + resid.value
+                    return spans, bad, bad
+                spans.extend((base + self._starts[i], self._sizes[i])
+                             for i in range(n))
+                base += resid.value
+                if n < self._cap:
+                    return spans, base, None
+        finally:
+            del arr  # release the buffer export before caller mutates
