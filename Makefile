@@ -1,0 +1,31 @@
+# Build/test entry points, mirroring the reference's workflow
+# (reference Makefile:53-66: `make test` runs the suite, `make check`
+# runs lint, plus a coverage target).  Everything here is stdlib +
+# baked-in tooling only.
+
+PYTHON ?= python3
+LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
+
+.PHONY: all test check native bench clean
+
+all: check test
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+check:
+	$(PYTHON) tools/lint.py $(LINT_TARGETS)
+
+# Build the native host codec (native/zkwire.cpp -> libzkwire.v*.so).
+# Optional: the runtime degrades to pure Python without it.
+native:
+	$(PYTHON) -c "from zkstream_tpu.utils import native; \
+	    p = native.build(); print(p or 'native build unavailable')"
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	rm -rf native/*.so native/*.so.tmp.* \
+	    $$(find . -name __pycache__ -not -path './.git/*') \
+	    .pytest_cache

@@ -7,7 +7,6 @@ are set here at conftest import time.
 
 import asyncio
 import inspect
-import os
 
 import pytest
 

@@ -45,7 +45,8 @@ def _random_stream(rng, nframes, max_body=64):
         xid = rng.choice([-1, -2, rng.randrange(1, 1 << 20)])
         zxid = rng.randrange(0, 1 << 62) if xid >= 0 else -1
         err = rng.choice([0, 0, 0, -101, -110])
-        body = bytes(rng.randrange(256) for _ in range(rng.randrange(max_body)))
+        body = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(max_body)))
         frames.append(_reply_frame(xid, zxid, err, body))
         metas.append((xid, zxid, err))
     return b''.join(frames), metas

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Dependency-free linter: the rebuild's `make check`.
+
+The reference gates commits on jsl + jsstyle (Makefile:24-36); this is
+the same idea for a stdlib-only environment: every file must parse,
+carry no unused imports, no tabs, no trailing whitespace, and no lines
+over 79 columns.  Exit status 1 on any finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 79
+
+
+def _imports(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield node.lineno, a.asname or a.name.split('.')[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == '__future__':
+                continue
+            for a in node.names:
+                if a.name != '*':
+                    yield node.lineno, a.asname or a.name
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    return used
+
+
+def lint_file(path: Path) -> list[str]:
+    problems: list[str] = []
+    text = path.read_text()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        return ['%s:%s: syntax error: %s' % (path, e.lineno, e.msg)]
+
+    if path.name != '__init__.py':  # __init__ imports are re-exports
+        used = _used_names(tree)
+        # names referenced only in docstrings or __all__ strings
+        for const in ast.walk(tree):
+            if (isinstance(const, ast.Constant)
+                    and isinstance(const.value, str)):
+                used.update(const.value.split())
+        for lineno, name in _imports(tree):
+            if name not in used and not name.startswith('_'):
+                problems.append('%s:%d: unused import %r'
+                                % (path, lineno, name))
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if '\t' in line:
+            problems.append('%s:%d: tab character' % (path, i))
+        if line != line.rstrip():
+            problems.append('%s:%d: trailing whitespace' % (path, i))
+        if len(line) > MAX_LINE and 'noqa' not in line:
+            problems.append('%s:%d: line too long (%d > %d)'
+                            % (path, i, len(line), MAX_LINE))
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    targets: list[Path] = []
+    for arg in argv or ['.']:
+        p = Path(arg)
+        if p.is_dir():
+            targets.extend(sorted(p.rglob('*.py')))
+        else:
+            targets.append(p)
+    problems: list[str] = []
+    for t in targets:
+        if '__pycache__' in t.parts:
+            continue
+        problems.extend(lint_file(t))
+    for p in problems:
+        print(p)
+    print('%d file(s) checked, %d problem(s)'
+          % (len(targets), len(problems)))
+    return 1 if problems else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

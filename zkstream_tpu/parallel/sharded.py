@@ -11,7 +11,6 @@ analogue of lib/zk-session.js:229-235.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

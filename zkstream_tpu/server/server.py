@@ -23,7 +23,7 @@ import logging
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
 from ..protocol.framing import PacketCodec
-from .store import ZKDatabase, ZKOpError, ZKServerSession, parent_path
+from .store import ZKDatabase, ZKOpError, ZKServerSession
 
 log = logging.getLogger('zkstream_tpu.server')
 

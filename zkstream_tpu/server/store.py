@@ -107,7 +107,8 @@ class ZKDatabase(EventEmitter):
         self.zxid = 0
         self.sessions: dict[int, ZKServerSession] = {}
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
-        self._next_session = (int(time.time() * 1000) << 24) & 0x7fffffffffff0000
+        self._next_session = ((int(time.time() * 1000) << 24)
+                              & 0x7fffffffffff0000)
 
     # -- zxid / time --
 

@@ -44,7 +44,8 @@ class StateScope:
         self._disposers.append(
             lambda: emitter.remove_listener(event, guarded))
 
-    def timeout(self, ms: float, cb: Callable[[], None]) -> asyncio.TimerHandle:
+    def timeout(self, ms: float,
+                cb: Callable[[], None]) -> asyncio.TimerHandle:
         loop = asyncio.get_event_loop()
         handle = loop.call_later(ms / 1000.0,
                                  lambda: self._valid and cb())
