@@ -36,6 +36,17 @@ def make_client(server, **kw):
     return c
 
 
+async def test_servers_accepts_dicts(server):
+    """servers[] takes {'address', 'port'} dicts like the reference's
+    address/port objects (reference: lib/client.js:63-76)."""
+    c = Client(servers=[{'address': '127.0.0.1', 'port': server.port}],
+               session_timeout=5000)
+    c.start()
+    await c.wait_connected(timeout=5)
+    await c.ping()
+    await c.close()
+
+
 async def test_connect_ping_close(server):
     c = make_client(server)
     events = []

@@ -145,6 +145,52 @@ async def test_session_migration_to_preferred_backend(ensemble):
     await c.close()
 
 
+async def test_session_migration_revert_on_failure(ensemble):
+    """If the move to a more-preferred backend fails mid-handshake, the
+    session must revert to its old, still-live connection without
+    dropping the session (reference: lib/zk-session.js:298-317)."""
+    await ensemble.kill(0)
+    c = make_client(ensemble, pin=0, decoherence_interval=300)
+    await c.wait_connected(timeout=10)
+    fallback = c.current_connection().backend.key
+    sid = c.session.session_id
+    states = []
+    c.session.on('stateChanged', lambda st: states.append(st))
+
+    # Impersonate the preferred member with a server that accepts the
+    # connection, swallows the ConnectRequest, then aborts: the
+    # migration attempt must fail and revert.
+    async def handler(reader, writer):
+        try:
+            await reader.read(64)
+        except (ConnectionError, OSError):
+            pass
+        writer.transport.abort()
+    fake = await asyncio.start_server(
+        handler, '127.0.0.1', ensemble.servers[0].port)
+
+    await wait_until(
+        lambda: 'reattaching' in states and states[-1] == 'attached',
+        timeout=10)
+    assert c.session.session_id == sid
+    assert c.is_connected()
+    assert c.current_connection().backend.key == fallback
+    await c.ping()
+
+    # Swap the fake for the real member: migration now succeeds.
+    fake.close()
+    await fake.wait_closed()
+    await ensemble.restart(0)
+    await wait_until(
+        lambda: c.is_connected() and
+        c.current_connection().backend.key ==
+        '127.0.0.1:%d' % ensemble.servers[0].port,
+        timeout=10)
+    assert c.session.session_id == sid
+    await c.ping()
+    await c.close()
+
+
 async def test_sequential_counter_shared_across_servers(ensemble):
     c1 = make_client(ensemble, pin=0)
     c2 = make_client(ensemble, pin=1)

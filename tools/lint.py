@@ -45,7 +45,10 @@ def _used_names(tree: ast.AST) -> set[str]:
 
 def lint_file(path: Path) -> list[str]:
     problems: list[str] = []
-    text = path.read_text()
+    try:
+        text = path.read_text()
+    except OSError as e:
+        return ['%s: cannot read: %s' % (path, e)]
     try:
         tree = ast.parse(text, filename=str(path))
     except SyntaxError as e:
@@ -53,11 +56,22 @@ def lint_file(path: Path) -> list[str]:
 
     if path.name != '__init__.py':  # __init__ imports are re-exports
         used = _used_names(tree)
-        # names referenced only in docstrings or __all__ strings
-        for const in ast.walk(tree):
-            if (isinstance(const, ast.Constant)
-                    and isinstance(const.value, str)):
-                used.update(const.value.split())
+        # Names referenced only in docstrings or __all__ strings count
+        # as used; other string literals (log messages, error text) do
+        # not get to mask a dead import.
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.ClassDef,
+                                 ast.FunctionDef, ast.AsyncFunctionDef)):
+                doc = ast.get_docstring(node, clean=False)
+                if doc:
+                    used.update(doc.split())
+            elif isinstance(node, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == '__all__'
+                       for t in node.targets):
+                    for const in ast.walk(node.value):
+                        if (isinstance(const, ast.Constant)
+                                and isinstance(const.value, str)):
+                            used.add(const.value)
         for lineno, name in _imports(tree):
             if name not in used and not name.startswith('_'):
                 problems.append('%s:%d: unused import %r'

@@ -52,7 +52,7 @@ DEFAULT_SESSION_TIMEOUT = 30000
 
 class Client(FSM):
     def __init__(self, address: str | None = None, port: int = 2181,
-                 servers: list[tuple[str, int]] | None = None,
+                 servers: list[tuple[str, int] | dict] | None = None,
                  session_timeout: int = DEFAULT_SESSION_TIMEOUT,
                  collector: Collector | None = None,
                  connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
@@ -64,7 +64,17 @@ class Client(FSM):
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
         else:
-            backends = [Backend(a, p) for (a, p) in servers]
+            # Accept both (address, port) pairs and {'address', 'port'}
+            # dicts — the reference's servers[] takes address/port
+            # objects (reference: lib/client.js:63-76).
+            backends = []
+            for s in servers:
+                if isinstance(s, dict):
+                    backends.append(Backend(s['address'],
+                                            int(s.get('port', port))))
+                else:
+                    a, p = s
+                    backends.append(Backend(a, int(p)))
 
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
