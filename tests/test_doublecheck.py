@@ -95,10 +95,16 @@ async def test_doublecheck_detects_missed_wakeup(
     assert isinstance(crashes[0], LostWakeupError)
 
 
-async def test_doublecheck_defers_when_disconnected(
-        fast_doublecheck, server):
+async def test_doublecheck_defers_when_disconnected(monkeypatch, server):
     """An armed watch whose session detached must not probe: it goes to
-    resuming, and the doublecheck timer only re-arms on reconnect."""
+    resuming, and the doublecheck timer only re-arms on reconnect.
+
+    Uses a 500 ms window (not the 80 ms fast fixture): the window must
+    be comfortably wider than the abort -> connection_lost gap, or the
+    timer could legitimately fire before the FSM hears about the dead
+    transport and the no-probe assertion would race."""
+    monkeypatch.setattr(watcher_mod, 'DOUBLECHECK_TIMEOUT', 500)
+    monkeypatch.setattr(watcher_mod, 'DOUBLECHECK_RAND', 0)
     c = Client(address='127.0.0.1', port=server.port,
                session_timeout=5000)
     c.start()

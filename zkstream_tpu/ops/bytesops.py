@@ -18,8 +18,13 @@ stay in bounds.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-_SIGN = jnp.int32(-0x80000000)  # 0x80000000 as an int32 bit pattern
+# 0x80000000 as an int32 bit pattern.  A numpy scalar, NOT jnp: a
+# module-level jnp scalar is a device array that jit captures as a
+# buffer constant, which costs ~2 ms per dispatch through a remote-TPU
+# tunnel; a np scalar inlines into the HLO as a literal.
+_SIGN = np.int32(-0x80000000)
 
 
 def _byte_at(buf, off):

@@ -56,25 +56,29 @@ def _kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
     lane = jax.lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
     n = len_ref[:]  # [R, 1]
 
-    def gather(cur, off):
-        """BE int32 at byte offset cur[r]+off per row, as one weighted
-        lane-reduce: each lane in the 4-byte window gets its big-endian
-        place value (1 << 8*(3-d)) and the row sum assembles the word —
-        non-overlapping bit planes, so wrapping int32 adds reproduce
-        the signed bit pattern exactly (the vectorized restatement of
-        lib/jute-buffer.js:102-106, formulated without lane-shifted
-        slices, which Mosaic miscompiles as of jax 0.9)."""
-        d = lane - (cur + off)
-        in_win = (d >= 0) & (d < 4)
-        w = jnp.where(in_win,
-                      jnp.int32(1) << jnp.where(in_win, 8 * (3 - d), 0),
-                      0)
-        return jnp.sum(b * w, axis=1, keepdims=True)
+    # Precompute, once per block, the big-endian int32 word STARTING at
+    # every byte position: w32[r, l] = b[l]<<24 | b[l+1]<<16 | b[l+2]<<8
+    # | b[l+3] (the vectorized restatement of lib/jute-buffer.js:102-106).
+    # Static lane rotates are native Mosaic ops; the wrap-around at the
+    # row tail only touches positions >= n - 3, which every reader below
+    # masks off.  Non-overlapping bit planes, so wrapping int32 adds
+    # reproduce the signed bit pattern exactly.
+    w32 = ((b << 24) + (pltpu.roll(b, Lp - 1, 1) << 16)
+           + (pltpu.roll(b, Lp - 2, 1) << 8) + pltpu.roll(b, Lp - 3, 1))
 
     def step(j, carry):
         cur, bad = carry  # bad is int32 0/1 (Mosaic-friendly carry)
+        # One subtract per step; each field read is then a single-lane
+        # equality select + row-sum over the precomputed words — no
+        # per-field variable shifts or int multiplies in the loop.
+        d = lane - cur
+
+        def gather(off):
+            return jnp.sum(jnp.where(d == off, w32, 0),
+                           axis=1, keepdims=True)
+
         has_prefix = cur + 4 <= n
-        ln = jnp.where(has_prefix, gather(cur, _LEN_OFF), 0)
+        ln = jnp.where(has_prefix, gather(_LEN_OFF), 0)
         is_bad = has_prefix & ((ln < 0) | (ln > MAX_PACKET))
         complete = (has_prefix & ~is_bad & (bad == 0)
                     & (cur + 4 + ln <= n))
@@ -84,10 +88,10 @@ def _kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
         # 16-byte reply header; shorter complete frames are protocol
         # violations surfaced via size (pipeline flags them as short)
         hdr_ok = complete & (ln >= 16)
-        xid = jnp.where(hdr_ok, gather(cur, _XID_OFF), 0)
-        zhi = jnp.where(hdr_ok, gather(cur, _ZHI_OFF), 0)
-        zlo = jnp.where(hdr_ok, gather(cur, _ZLO_OFF), 0)
-        err = jnp.where(hdr_ok, gather(cur, _ERR_OFF), 0)
+        xid = jnp.where(hdr_ok, gather(_XID_OFF), 0)
+        zhi = jnp.where(hdr_ok, gather(_ZHI_OFF), 0)
+        zlo = jnp.where(hdr_ok, gather(_ZLO_OFF), 0)
+        err = jnp.where(hdr_ok, gather(_ERR_OFF), 0)
 
         row = pl.ds(j, 1)
         starts_ref[row, :] = start.reshape(1, R)

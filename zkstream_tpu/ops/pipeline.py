@@ -9,10 +9,13 @@ connection, but as a single fused XLA computation with static shapes.
 
 This is the unit the driver compile-checks (see __graft_entry__.py) and
 the benchmark measures (bench.py).  Two equivalent implementations:
-``wire_pipeline_step`` (pure jnp/lax — runs anywhere) and
-``wire_pipeline_step_pallas`` (the scan + header parse fused into one
-Mosaic kernel, ops/pallas_scan.py — ~2.5x faster on TPU v5e); both
+``wire_pipeline_step`` (pure jnp/lax — runs anywhere; the XLA scan
+gathers only the ~20 header bytes per frame, so it is the fast path on
+TPU v5e) and ``wire_pipeline_step_pallas`` (the scan + header parse
+fused into one Mosaic kernel, ops/pallas_scan.py — a single
+custom-call, worth it when per-op dispatch overhead dominates); both
 share :func:`_assemble` so the routing/stats semantics cannot diverge.
+bench.py times both and reports the best.
 """
 
 from __future__ import annotations
