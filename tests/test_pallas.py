@@ -108,3 +108,24 @@ def test_pallas_empty_and_full_rows():
                                     block_rows=8, interpret=True)
     _assert_same(want, got)
     assert int(got.n_frames[1]) == 1 and bool(got.bad[2])
+
+
+def test_vmem_guard_and_fallback():
+    """Shapes whose kernel would blow the scoped-VMEM limit must raise
+    a clear error from pallas_wire_scan, and wire_pipeline_step_pallas
+    must transparently fall back to the jnp pipeline for them."""
+    from zkstream_tpu.ops.pallas_scan import fits_vmem, pallas_wire_scan
+
+    assert fits_vmem(256, 5000, max_frames=48, block_rows=128)
+    # observed Mosaic stack OOMs: R=256 x Lp~5120 and R=128 x Lp~13568
+    assert not fits_vmem(256, 5000, max_frames=48, block_rows=256)
+    assert not fits_vmem(1024, 13440, max_frames=128, block_rows=128)
+
+    buf = jnp.zeros((1024, 13440), jnp.uint8)
+    lens = jnp.zeros((1024,), jnp.int32)
+    with pytest.raises(ValueError, match='scoped VMEM'):
+        pallas_wire_scan(buf, lens, max_frames=128, block_rows=128)
+    # The pipeline wrapper silently takes the jnp path instead.
+    out = wire_pipeline_step_pallas(buf, lens, max_frames=128,
+                                    block_rows=128)
+    assert int(out.n_frames.sum()) == 0

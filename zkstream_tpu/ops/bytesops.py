@@ -97,8 +97,6 @@ def u64pair_reduce_max(h, l, axis=None):
 def u64pair_to_int(h, l) -> int:
     """Host-side: collapse a (hi, lo) pair (or arrays thereof) to Python
     int / numpy int64 for interop with the scalar codec."""
-    import numpy as np
-
     h = (np.asarray(h).astype(np.int64) & 0xFFFFFFFF).astype(np.uint64)
     l = (np.asarray(l).astype(np.int64) & 0xFFFFFFFF).astype(np.uint64)
     out = (h << np.uint64(32)) | l

@@ -114,6 +114,46 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+#: TPU v5e scoped-VMEM ceiling for one kernel program.
+_VMEM_LIMIT = 16 * 1024 * 1024
+
+
+def _vmem_estimate(R: int, Lp: int, max_frames: int) -> int:
+    """Projected scoped-VMEM bytes for one program: ~3 int32 planes of
+    [R, Lp] live at once (byte plane, rolled word plane, lane iota /
+    temporaries) plus the double-buffered u8 input and the [F, R]
+    output blocks.  Calibrated against observed Mosaic stack OOMs
+    (20.8M at R=256, Lp=5120; 20.5M at R=128, Lp=13568)."""
+    plane = R * Lp * 4
+    return int(3.2 * plane) + 6 * max_frames * R * 4 + (1 << 20)
+
+
+def _block_shape(B: int, L: int, block_rows: int,
+                 interpret: bool = False) -> tuple[int, int, int]:
+    """(R, Bp, Lp) blocking for one kernel program.  Mosaic tiling: the
+    [F, R] output blocks put rows on the lane axis, so a multi-block
+    grid needs R % 128 == 0; a single block spanning the whole (padded)
+    batch is exempt.  Shared by the compile path and fits_vmem so the
+    guard can never drift from the actual blocking."""
+    if interpret:
+        R = min(block_rows, _round_up(B, 8))
+        Bp = _round_up(B, R)
+    elif B <= block_rows:
+        R = Bp = _round_up(B, 8)
+    else:
+        R = _round_up(block_rows, 128)
+        Bp = _round_up(B, R)
+    return R, Bp, _round_up(L + _PAD, 128)
+
+
+def fits_vmem(B: int, L: int, max_frames: int = 32,
+              block_rows: int = 64) -> bool:
+    """Whether :func:`pallas_wire_scan` can compile for this shape
+    without exceeding the per-program scoped-VMEM limit."""
+    R, _Bp, Lp = _block_shape(B, L, block_rows)
+    return _vmem_estimate(R, Lp, max_frames) <= _VMEM_LIMIT
+
+
 @functools.partial(
     jax.jit, static_argnames=('max_frames', 'block_rows', 'interpret'))
 def pallas_wire_scan(buf, lens, max_frames: int = 32,
@@ -134,18 +174,18 @@ def pallas_wire_scan(buf, lens, max_frames: int = 32,
       ``frame_cursor_scan`` + ``parse_reply_headers``.
     """
     B, L = buf.shape
-    # Mosaic tiling: the [F, R] output blocks put rows on the lane
-    # axis, so a multi-block grid needs R % 128 == 0; a single block
-    # spanning the whole (padded) batch is exempt.
-    if interpret:
-        R = min(block_rows, _round_up(B, 8))
-        Bp = _round_up(B, R)
-    elif B <= block_rows:
-        R = Bp = _round_up(B, 8)
-    else:
-        R = _round_up(block_rows, 128)
-        Bp = _round_up(B, R)
-    Lp = _round_up(L + _PAD, 128)
+    R, Bp, Lp = _block_shape(B, L, block_rows, interpret)
+    if not interpret and \
+            _vmem_estimate(R, Lp, max_frames) > _VMEM_LIMIT:
+        raise ValueError(
+            'pallas_wire_scan shape (rows/program R=%d from '
+            'block_rows=%d, L=%d, max_frames=%d) needs ~%d MiB of '
+            'scoped VMEM (> %d MiB limit); shrink block_rows or L, or '
+            'use the jnp pipeline (wire_pipeline_step), which has no '
+            'such bound'
+            % (R, block_rows, L, max_frames,
+               _vmem_estimate(R, Lp, max_frames) >> 20,
+               _VMEM_LIMIT >> 20))
 
     buf = jnp.zeros((Bp, Lp), jnp.uint8).at[:B, :L].set(buf)
     lens = jnp.zeros((Bp, 1), jnp.int32).at[:B, 0].set(

@@ -75,9 +75,16 @@ def wire_pipeline_step_pallas(buf, lens, max_frames: int = 32,
                               interpret: bool = False) -> WireStats:
     """Same step as :func:`wire_pipeline_step`, with the scan + header
     parse fused into one Pallas kernel (ops/pallas_scan.py); only the
-    cheap [B, F] -> [B] routing reductions remain as XLA ops."""
-    from .pallas_scan import pallas_wire_scan
+    cheap [B, F] -> [B] routing reductions remain as XLA ops.
 
+    Shapes whose kernel would exceed the per-program scoped-VMEM limit
+    fall back to the (unbounded, usually faster) jnp pipeline instead
+    of failing to compile."""
+    from .pallas_scan import fits_vmem, pallas_wire_scan
+
+    if not interpret and not fits_vmem(buf.shape[0], buf.shape[1],
+                                       max_frames, block_rows):
+        return wire_pipeline_step(buf, lens, max_frames=max_frames)
     r = pallas_wire_scan(buf, lens, max_frames=max_frames,
                          block_rows=block_rows, interpret=interpret)
     valid = r['starts'] >= 0
