@@ -1,0 +1,110 @@
+"""Structured-logging tests: child-context accretion through the
+client/connection/session stack (the rebuild's equivalent of the
+reference's bunyan child loggers, lib/client.js:34-45,
+lib/connection-fsm.js:93-96,209-211, lib/zk-session.js:179-181)."""
+
+import logging
+
+import pytest
+
+from helpers import wait_until
+from zkstream_tpu import Client, Logger
+from zkstream_tpu.server import ZKServer
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=1)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture
+def server(event_loop):
+    srv = event_loop.run_until_complete(ZKServer().start())
+    yield srv
+    event_loop.run_until_complete(srv.stop())
+
+
+def test_child_merges_context():
+    base = Logger()
+    a = base.child(component='A', x=1)
+    b = a.child(x=2, y=3)
+    assert a.context == {'component': 'A', 'x': 1}
+    assert b.context == {'component': 'A', 'x': 2, 'y': 3}
+    # children never mutate the parent
+    assert base.context == {}
+
+
+def test_records_carry_context_suffix_and_extra():
+    lg = logging.getLogger('zkstream_tpu.test.capture')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        Logger(lg).child(component='X', n=7).info('hello %d', 42)
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    assert rec.getMessage() == 'hello 42 [component=X n=7]'
+    assert rec.zk_context == {'component': 'X', 'n': 7}
+
+
+def test_percent_in_context_value_is_safe():
+    """A context value containing '%' (e.g. IPv6 zone id) must not be
+    treated as a format directive when the call carries args."""
+    lg = logging.getLogger('zkstream_tpu.test.pct')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        Logger(lg).child(zkAddress='fe80::1%eth0').debug(
+            'ping ok in %d ms', 3)
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    assert rec.getMessage() == 'ping ok in 3 ms [zkAddress=fe80::1%eth0]'
+
+
+def test_wrapping_a_logger_facade_merges():
+    lg = logging.getLogger('zkstream_tpu.test.wrap')
+    inner = Logger(lg).child(a=1)
+    outer = Logger(inner, {'b': 2})
+    assert outer.base is lg
+    assert outer.context == {'a': 1, 'b': 2}
+
+
+async def test_client_stack_accretes_context(server):
+    """Connection records carry zkAddress/zkPort; once the session is
+    up, session and connection records carry sessionId."""
+    lg = logging.getLogger('zkstream_tpu.test.e2e')
+    lg.setLevel(1)
+    lg.propagate = False
+    cap = _Capture()
+    lg.addHandler(cap)
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, log=Logger(lg))
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.ping()
+        await wait_until(lambda: any(
+            getattr(r, 'zk_context', {}).get('component') == 'ZKSession'
+            and 'sessionId' in r.zk_context for r in cap.records))
+    finally:
+        await c.close()
+        lg.removeHandler(cap)
+
+    ctxs = [getattr(r, 'zk_context', {}) for r in cap.records]
+    conn_ctxs = [x for x in ctxs
+                 if x.get('component') == 'ZKConnectionFSM']
+    assert conn_ctxs, 'no connection records captured'
+    assert all(x['zkAddress'] == '127.0.0.1' and
+               x['zkPort'] == server.port for x in conn_ctxs)
+    # Post-handshake connection records accrete the session id.
+    sid = c.session.get_session_id()
+    assert any(x.get('sessionId') == sid for x in conn_ctxs)
+    sess_ctxs = [x for x in ctxs if x.get('component') == 'ZKSession']
+    assert any(x.get('sessionId') == sid for x in sess_ctxs)

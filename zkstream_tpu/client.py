@@ -24,7 +24,6 @@ Usage::
 from __future__ import annotations
 
 import asyncio
-import logging
 
 from .io.connection import Backend, ZKConnection
 from .io.pool import (
@@ -40,9 +39,8 @@ from .protocol.consts import CreateFlag
 from .protocol.errors import ZKNotConnectedError
 from .protocol.records import OPEN_ACL_UNSAFE, Stat
 from .utils.fsm import FSM
+from .utils.logging import Logger
 from .utils.metrics import Collector
-
-log = logging.getLogger('zkstream_tpu.client')
 
 METRIC_ZK_EVENT_COUNTER = 'zookeeper_events'
 
@@ -59,7 +57,8 @@ class Client(FSM):
                  default_policy: RecoveryPolicy = DEFAULT_POLICY,
                  decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
                  shuffle_backends: bool = True,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 log: Logger | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -75,6 +74,11 @@ class Client(FSM):
                 else:
                     a, p = s
                     backends.append(Backend(a, int(p)))
+
+        # Injectable logger, like the reference's opts.log (reference:
+        # lib/client.js:34-45); components derive context-accreting
+        # children from it.
+        self.log = Logger(log).child(component='ZKClient')
 
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
@@ -150,7 +154,7 @@ class Client(FSM):
     def _new_session(self) -> None:
         if not self.is_in_state('normal'):
             return
-        s = ZKSession(self.session_timeout, self.collector)
+        s = ZKSession(self.session_timeout, self.collector, log=self.log)
         self.session = s
 
         def initial_handler(st):

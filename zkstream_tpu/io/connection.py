@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import logging
 import time
 from typing import Callable
 
@@ -27,8 +26,7 @@ from ..protocol.errors import ZKError, ZKPingTimeoutError, ZKProtocolError
 from ..protocol.framing import PacketCodec
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
-
-log = logging.getLogger('zkstream_tpu.connection')
+from ..utils.logging import Logger
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,6 +88,12 @@ class ZKConnection(FSM):
         #: (reference: lib/connection-fsm.js:174).
         self.client = client
         self.backend = backend
+        # Child logger carrying this connection's address context
+        # (reference: lib/connection-fsm.js:93-96); sessionId accretes
+        # once connected (reference: lib/connection-fsm.js:209-211).
+        self.log = getattr(client, 'log', Logger()).child(
+            component='ZKConnectionFSM', zkAddress=backend.address,
+            zkPort=backend.port)
         self.codec: PacketCodec | None = None
         self.transport = None
         self.session = None
@@ -128,7 +132,7 @@ class ZKConnection(FSM):
 
     def state_connecting(self, S) -> None:
         self.codec = PacketCodec()
-        log.debug('%s: attempting new connection', self.backend.key)
+        self.log.debug('attempting new connection')
 
         async def dial():
             loop = asyncio.get_event_loop()
@@ -203,8 +207,8 @@ class ZKConnection(FSM):
         # (reference: lib/connection-fsm.js:180-187, the nasty.test.js
         # monitor-mode race).
         if self.session.is_attaching():
-            log.debug('%s: session in state %s while handshaking',
-                      self.backend.key, self.session.get_state())
+            self.log.debug('session in state %s while handshaking',
+                           self.session.get_state())
             self.last_error = ZKProtocolError('ATTACH_RACE',
                 'ZKSession attaching to another connection')
             S.goto_state('error')
@@ -221,6 +225,8 @@ class ZKConnection(FSM):
         # Handshake is over: steady-state request/reply framing from here
         # (the reference flips this per-frame via isInState checks).
         self.codec.handshaking = False
+        self.log = self.log.child(
+            sessionId=self.session.get_session_id())
 
         ping_interval = max(self.session.get_timeout() / 4, 2000)
         S.interval(ping_interval, self.ping)
@@ -275,8 +281,8 @@ class ZKConnection(FSM):
             if close_xid[0] is not None:
                 return
             close_xid[0] = self.next_xid()
-            log.info('%s: sent CLOSE_SESSION request (xid %d)',
-                     self.backend.key, close_xid[0])
+            self.log.info('sent CLOSE_SESSION request (xid %d)',
+                          close_xid[0])
             self._write({'opcode': 'CLOSE_SESSION', 'xid': close_xid[0]})
             try:
                 if self.transport and self.transport.can_write_eof():
@@ -312,8 +318,8 @@ class ZKConnection(FSM):
             send_close_session()
 
     def state_error(self, S) -> None:
-        log.warning('%s: error communicating with ZK: %s',
-                    self.backend.key, self.last_error)
+        self.log.warning('error communicating with ZK: %s',
+                         self.last_error)
         reqs, self.reqs = self.reqs, {}
         for req in reqs.values():
             req.emit('error', self.last_error)
@@ -360,8 +366,8 @@ class ZKConnection(FSM):
         """Route a reply to its pending request
         (reference: lib/connection-fsm.js:353-376)."""
         req = self.reqs.get(pkt['xid'])
-        log.debug('%s: server replied to xid %d err %s',
-                  self.backend.key, pkt['xid'], pkt['err'])
+        self.log.trace('server replied to xid %d err %s',
+                       pkt['xid'], pkt['err'])
         if req is None:
             return
         if pkt['err'] == 'OK':
@@ -384,8 +390,8 @@ class ZKConnection(FSM):
         req.once('reply', end_request)
         req.once('error', end_request)
 
-        log.debug('%s: sent request xid %d opcode %s',
-                  self.backend.key, pkt['xid'], pkt['opcode'])
+        self.log.trace('sent request xid %d opcode %s',
+                       pkt['xid'], pkt['opcode'])
         self._write(pkt)
         return req
 
@@ -418,7 +424,7 @@ class ZKConnection(FSM):
             self.reqs.pop(consts.XID_PING, None)
             timer.cancel()
             latency = (time.monotonic() - t1) * 1000.0
-            log.debug('%s: ping ok in %d ms', self.backend.key, latency)
+            self.log.debug('ping ok in %d ms', latency)
             if cb:
                 cb(None, latency)
 

@@ -17,16 +17,14 @@ a more-preferred backend with revert-on-failure
 from __future__ import annotations
 
 import asyncio
-import logging
 import time
 
 from ..protocol import consts
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
+from ..utils.logging import Logger
 from ..utils.metrics import Collector
 from .watcher import ZKWatcher
-
-log = logging.getLogger('zkstream_tpu.session')
 
 METRIC_ZK_NOTIFICATION_COUNTER = 'zookeeper_notifications'
 
@@ -40,7 +38,11 @@ _NOTIFICATION_EVENTS = {
 
 
 class ZKSession(FSM):
-    def __init__(self, timeout: int, collector: Collector | None = None):
+    def __init__(self, timeout: int, collector: Collector | None = None,
+                 log: Logger | None = None):
+        # Child logger; sessionId accretes once the server assigns one
+        # (reference: lib/zk-session.js:42-44,179-181).
+        self.log = Logger(log).child(component='ZKSession')
         self.conn = None
         self.old_conn = None
         #: Wall-clock ms of the last packet; liveness = a packet within
@@ -151,8 +153,10 @@ class ZKSession(FSM):
                 S.goto_state('expired')
                 return
             verb = 'resumed' if self.session_id != 0 else 'created'
-            log.info('%s zookeeper session %016x with timeout %d ms',
-                     verb, pkt['sessionId'], pkt['timeOut'])
+            self.log = self.log.child(
+                sessionId='%016x' % (pkt['sessionId'],))
+            self.log.info('%s zookeeper session with timeout %d ms',
+                          verb, pkt['timeOut'])
             self.timeout = pkt['timeOut']
             self.session_id = pkt['sessionId']
             self.passwd = pkt['passwd']
@@ -221,9 +225,9 @@ class ZKSession(FSM):
             if pkt['sessionId'] == 0:
                 revert()
                 return
-            log.info('moved zookeeper session %016x to more preferred '
-                     'backend (%s) with timeout %d ms', pkt['sessionId'],
-                     self.conn.backend.key, pkt['timeOut'])
+            self.log.info('moved zookeeper session to more preferred '
+                          'backend (%s) with timeout %d ms',
+                          self.conn.backend.key, pkt['timeOut'])
             self.timeout = pkt['timeOut']
             self.session_id = pkt['sessionId']
             self.passwd = pkt['passwd']
@@ -234,9 +238,8 @@ class ZKSession(FSM):
 
         def revert(*args):
             if self.is_alive() and self.old_conn.is_in_state('connected'):
-                log.warning('reverted move of session %016x to new '
-                            'backend (%s)', self.session_id,
-                            self.conn.backend.key)
+                self.log.warning('reverted move of session to new '
+                                 'backend (%s)', self.conn.backend.key)
                 self.conn = self.old_conn
                 self.old_conn = None
                 S.goto_state('attached')
@@ -258,9 +261,9 @@ class ZKSession(FSM):
             S.goto_state('closing')
         S.on(self, 'closeAsserted', on_close_asserted)
 
-        log.debug('attempting to move zookeeper session %016x from %s '
-                  'to %s', self.session_id, self.old_conn.backend.key,
-                  self.conn.backend.key)
+        self.log.debug('attempting to move zookeeper session from %s '
+                       'to %s', self.old_conn.backend.key,
+                       self.conn.backend.key)
 
         self.conn.send({
             'protocolVersion': consts.PROTOCOL_VERSION,
@@ -281,14 +284,14 @@ class ZKSession(FSM):
             self.conn.destroy()
         self.conn = None
         self._cancel_expiry_timer()
-        log.warning('ZK session expired')
+        self.log.warning('ZK session expired')
 
     def state_closed(self, S) -> None:
         if self.conn is not None:
             self.conn.destroy()
         self.conn = None
         self._cancel_expiry_timer()
-        log.info('ZK session closed')
+        self.log.info('ZK session closed')
 
     # -- watcher plumbing --
 
@@ -303,11 +306,11 @@ class ZKSession(FSM):
         """Dispatch a NOTIFICATION to the right path's watcher
         (reference: lib/zk-session.js:389-419)."""
         if pkt['state'] != 'SYNC_CONNECTED':
-            log.warning('received notification with bad state %s',
-                        pkt['state'])
+            self.log.warning('received notification with bad state %s',
+                             pkt['state'])
             return
         evt = _NOTIFICATION_EVENTS[pkt['type']]
-        log.debug('notification %s for %s', evt, pkt['path'])
+        self.log.trace('notification %s for %s', evt, pkt['path'])
         self.collector.get_collector(
             METRIC_ZK_NOTIFICATION_COUNTER).increment({'event': evt})
         watcher = self.watchers.get(pkt['path'])
@@ -346,12 +349,12 @@ class ZKSession(FSM):
         if count < 1:
             return
         zxid = self.last_zxid
-        log.info('re-arming %d node watchers at zxid %x', count, zxid)
+        self.log.info('re-arming %d node watchers at zxid %x', count, zxid)
 
         def done(err):
             if err is not None:
-                log.warning('SET_WATCHES failed during watch resumption: '
-                            '%s', err)
+                self.log.warning('SET_WATCHES failed during watch '
+                                 'resumption: %s', err)
                 return
             for event in all_evts:
                 event.resume()
