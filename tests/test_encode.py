@@ -1,0 +1,121 @@
+"""Encode->decode self-inverse property tests: the batched wire
+encoder (ops/encode.py) must be exactly inverted by the decode pipeline
+(ops/pipeline.py), and must agree byte-for-byte with the scalar codec's
+framing (the reference's isServer encode mode,
+lib/zk-streams.js:121-148)."""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from zkstream_tpu.ops.encode import build_reply_streams  # noqa: E402
+from zkstream_tpu.ops.pipeline import wire_pipeline_step  # noqa: E402
+from zkstream_tpu.protocol.framing import FrameDecoder  # noqa: E402
+
+
+def _planes(rng, B, F):
+    xid = np.zeros((B, F), np.int32)
+    zhi = np.zeros((B, F), np.int32)
+    zlo = np.zeros((B, F), np.int32)
+    err = np.zeros((B, F), np.int32)
+    sizes = np.zeros((B, F), np.int32)
+    for i in range(B):
+        n = rng.randrange(0, F + 1)
+        for j in range(n):
+            xid[i, j] = rng.choice([-2, -1, rng.randrange(1, 1 << 20)])
+            z = rng.randrange(0, 1 << 48)
+            zhi[i, j] = z >> 32
+            zlo[i, j] = np.uint32(z & 0xFFFFFFFF).astype(np.int32)
+            err[i, j] = rng.choice([0, 0, -101])
+            sizes[i, j] = 16 + rng.randrange(0, 40)
+        # absent frames marked by sizes < 16
+    return map(jnp.asarray, (xid, zhi, zlo, err, sizes))
+
+
+@pytest.mark.parametrize('seed', [0, 1])
+def test_encode_decode_roundtrip(seed):
+    rng = random.Random(seed)
+    B, F, L = 16, 8, 512
+    xid, zhi, zlo, err, sizes = _planes(rng, B, F)
+    buf, lens = jax.jit(
+        lambda *a: build_reply_streams(*a, out_len=L))(
+            xid, zhi, zlo, err, sizes)
+    out = wire_pipeline_step(buf, lens, max_frames=F)
+
+    valid = np.asarray(sizes) >= 16
+    np.testing.assert_array_equal(
+        np.asarray(out.n_frames), valid.sum(axis=1))
+    np.testing.assert_array_equal(
+        np.asarray(out.xids), np.where(valid, np.asarray(xid), 0))
+    np.testing.assert_array_equal(
+        np.asarray(out.errs), np.where(valid, np.asarray(err), 0))
+    np.testing.assert_array_equal(
+        np.asarray(out.sizes), np.where(valid, np.asarray(sizes), 0))
+    assert not np.asarray(out.bad).any()
+    # no partial frames: resid == lens
+    np.testing.assert_array_equal(np.asarray(out.resid),
+                                  np.asarray(lens))
+
+
+def test_encode_matches_scalar_codec():
+    """Byte-level agreement with the scalar framing: feed encoded rows
+    through FrameDecoder and unpack headers with struct."""
+    rng = random.Random(7)
+    B, F, L = 8, 6, 400
+    xid, zhi, zlo, err, sizes = _planes(rng, B, F)
+    buf, lens = build_reply_streams(xid, zhi, zlo, err, sizes, out_len=L)
+    buf, lens = np.asarray(buf), np.asarray(lens)
+    hdr = struct.Struct('>iqi')
+    for i in range(B):
+        dec = FrameDecoder(use_native=False)
+        bodies = list(dec.feed(bytes(buf[i, :lens[i]])))
+        want = [(int(np.asarray(xid)[i, j]),
+                 (int(np.asarray(zhi)[i, j]) << 32) |
+                 (int(np.asarray(zlo)[i, j]) & 0xFFFFFFFF),
+                 int(np.asarray(err)[i, j]),
+                 int(np.asarray(sizes)[i, j]))
+                for j in range(F) if int(np.asarray(sizes)[i, j]) >= 16]
+        assert len(bodies) == len(want)
+        for body, (wx, wz, we, wsz) in zip(bodies, want):
+            assert len(body) == wsz
+            x, z, e = hdr.unpack_from(body, 0)
+            assert (x, z & 0xFFFFFFFFFFFFFFFF, e) == \
+                (wx, wz & 0xFFFFFFFFFFFFFFFF, we)
+
+
+def test_encode_compacts_interleaved_absent_frames():
+    """Absent frames (sizes < 16) anywhere in the plane are omitted
+    from the wire; decode yields the survivors left-packed in order."""
+    xid = jnp.asarray([[7, 8, 9]], jnp.int32)
+    zhi = jnp.zeros((1, 3), jnp.int32)
+    zlo = jnp.zeros((1, 3), jnp.int32)
+    err = jnp.zeros((1, 3), jnp.int32)
+    sizes = jnp.asarray([[16, 0, 20]], jnp.int32)  # middle one absent
+    buf, lens = build_reply_streams(xid, zhi, zlo, err, sizes,
+                                    out_len=64)
+    assert int(lens[0]) == 20 + 24
+    out = wire_pipeline_step(buf, lens, max_frames=3)
+    assert int(out.n_frames[0]) == 2
+    np.testing.assert_array_equal(np.asarray(out.xids)[0], [7, 9, 0])
+    np.testing.assert_array_equal(np.asarray(out.sizes)[0], [16, 20, 0])
+
+
+def test_encode_drops_overflowing_frames():
+    """Frames that do not fit in out_len are dropped and excluded from
+    lens; everything before them survives."""
+    xid = jnp.asarray([[1, 2, 3]], jnp.int32)
+    zhi = jnp.zeros((1, 3), jnp.int32)
+    zlo = jnp.zeros((1, 3), jnp.int32)
+    err = jnp.zeros((1, 3), jnp.int32)
+    sizes = jnp.asarray([[16, 16, 16]], jnp.int32)  # 20 bytes each
+    buf, lens = build_reply_streams(xid, zhi, zlo, err, sizes,
+                                    out_len=45)
+    assert int(lens[0]) == 40  # two frames fit, third dropped
+    out = wire_pipeline_step(buf, lens, max_frames=3)
+    assert int(out.n_frames[0]) == 2
+    np.testing.assert_array_equal(np.asarray(out.xids)[0], [1, 2, 0])

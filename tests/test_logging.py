@@ -52,6 +52,37 @@ def test_records_carry_context_suffix_and_extra():
     assert rec.zk_context == {'component': 'X', 'n': 7}
 
 
+def test_records_attribute_to_the_call_site():
+    """%(filename)s / %(funcName)s must point at the caller, not at the
+    Logger facade internals."""
+    lg = logging.getLogger('zkstream_tpu.test.site')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        Logger(lg).child(c=1).info('where am i')
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    assert rec.filename == 'test_logging.py'
+    assert rec.funcName == 'test_records_attribute_to_the_call_site'
+
+
+def test_format_mismatch_is_contained():
+    """A bad format/args pair must not raise at the call site (it would
+    kill an FSM state handler); it degrades to repr-appended args."""
+    lg = logging.getLogger('zkstream_tpu.test.mismatch')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        Logger(lg).info('oops %d', 'not-an-int')
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    assert "oops %d ('not-an-int',)" == rec.getMessage()
+
+
 def test_percent_in_context_value_is_safe():
     """A context value containing '%' (e.g. IPv6 zone id) must not be
     treated as a format directive when the call carries args."""

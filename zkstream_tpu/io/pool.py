@@ -25,13 +25,11 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import logging
 import random
 
 from ..utils.events import EventEmitter
+from ..utils.logging import Logger
 from .connection import Backend, ZKConnection
-
-log = logging.getLogger('zkstream_tpu.pool')
 
 
 @dataclasses.dataclass
@@ -60,6 +58,8 @@ class ConnectionPool(EventEmitter):
         super().__init__()
         assert backends, 'at least one backend required'
         self._client = client
+        self.log = getattr(client, 'log', Logger()).child(
+            component='ConnectionPool')
         self._backends = list(backends)
         if shuffle:
             random.Random(seed).shuffle(self._backends)
@@ -214,9 +214,9 @@ class ConnectionPool(EventEmitter):
             if not self._failed_emitted:
                 self._failed_emitted = True
                 self._set_state('failed')
-                log.warning('failed to connect to any ZK backend '
-                            '(exhausted retry policy); entering monitor '
-                            'mode')
+                self.log.warning('failed to connect to any ZK backend '
+                                 '(exhausted retry policy); entering '
+                                 'monitor mode')
             policy = self._default_policy
             await asyncio.sleep(policy.delay / 1000.0)
 
@@ -269,8 +269,8 @@ class ConnectionPool(EventEmitter):
                 if self._stopping:
                     return
                 backend = self._backends[idx]
-                log.debug('decoherence: trying preferred backend %s',
-                          backend.key)
+                self.log.debug('decoherence: trying preferred backend '
+                               '%s', backend.key)
                 conn = await self._dial_one(backend,
                                             self._connect_policy.timeout)
                 if self._stopping:

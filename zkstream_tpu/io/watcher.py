@@ -27,13 +27,11 @@ the duplicate user-facing emits this can cause.
 
 from __future__ import annotations
 
-import logging
 import random
 
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
-
-log = logging.getLogger('zkstream_tpu.watcher')
+from ..utils.logging import Logger
 
 #: Idle window after which an armed watch probes the server to check it
 #: has not missed a wakeup (reference: lib/zk-session.js:27-36).
@@ -123,6 +121,8 @@ class ZKWatchEvent(FSM):
         self.session = session
         self.emitter = emitter
         self.evt = evt
+        self.log = getattr(session, 'log', Logger()).child(
+            component='ZKWatchEvent', path=path, event=evt)
         self.prev_zxid: int | None = None
         super().__init__('disarmed')
 
@@ -166,8 +166,7 @@ class ZKWatchEvent(FSM):
             if state == 'attached':
                 S.goto_state('wait_connected')
         S.on(self.session, 'stateChanged', on_state)
-        log.debug('%s/%s: deferring watcher arm until after reconnect',
-                  self.path, self.evt)
+        self.log.debug('deferring watcher arm until after reconnect')
 
     def state_wait_connected(self, S) -> None:
         conn = self.session.get_connection()
@@ -225,8 +224,8 @@ class ZKWatchEvent(FSM):
                 # park until it is created.
                 S.goto_state('wait_node')
                 return
-            log.debug('%s/%s: watcher attach failure (%s); will retry',
-                      self.path, self.evt, err)
+            self.log.debug('watcher attach failure (%s); will retry',
+                           err)
             S.goto_state('wait_session')
         S.on(req, 'error', on_error)
 

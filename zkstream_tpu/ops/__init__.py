@@ -20,8 +20,12 @@ connection streams can be decoded in one fused XLA computation:
   notification counts) (lib/zk-session.js:229-235).
 - :mod:`pipeline` — the flagship jittable step combining all of the
   above for a [batch, stream_len] tensor of raw connection bytes.
+- :mod:`encode` — the inverse direction: batched field planes ->
+  length-prefixed reply streams (the tensor restatement of the scalar
+  codec's isServer encode mode, lib/zk-streams.js:121-148).
 """
 
+from .encode import build_reply_streams
 from .bytesops import (
     be_i32_at,
     be_i64pair_at,
@@ -39,6 +43,7 @@ from .pipeline import WireStats, wire_pipeline_step
 
 __all__ = [
     'MAX_PACKET',
+    'build_reply_streams',
     'be_i32_at',
     'be_i64pair_at',
     'u64pair_max',

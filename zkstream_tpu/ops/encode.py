@@ -1,0 +1,75 @@
+"""Batched wire ENCODE: field planes -> framed reply streams.
+
+The decode pipeline (ops/pipeline.py) turns [B, L] byte streams into
+header field planes; this is its inverse — given per-frame fields, emit
+length-prefixed ZooKeeper reply frames for a whole fleet of streams in
+one jitted computation.  It restates the scalar encoder's header pack
+(reference: lib/zk-buffer.js:186-231 writes len/xid/zxid/err the same
+way for the ``isServer`` codec mode that the reference uses to build
+fake test servers, lib/zk-streams.js:121-148) as a scatter of byte
+planes at cumulative frame offsets.
+
+Use cases: generating decode-bench fleets on device, fake-server
+fleets for adversarial testing, and the encode->decode self-inverse
+property test (tests/test_encode.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: Reply header bytes: len prefix (4) + xid (4) + zxid (8) + err (4).
+_HDR = 20
+
+
+def _be_bytes(word, n: int = 4):
+    """int32 [..., 1] -> n big-endian byte planes [..., n] (uint8)."""
+    shifts = jnp.arange(8 * (n - 1), -1, -8, dtype=jnp.int32)
+    return ((word >> shifts) & 0xFF).astype(jnp.uint8)
+
+
+def build_reply_streams(xid, zxid_hi, zxid_lo, err, body_sizes,
+                        out_len: int):
+    """Encode a fleet of reply streams.
+
+    Args:
+      xid, zxid_hi, zxid_lo, err: int32 [B, F] per-frame header fields.
+      body_sizes: int32 [B, F] reply body length per frame INCLUDING
+        the 16-byte header (the value that goes in the length prefix);
+        < 16 marks the frame absent (not emitted).  Body bytes beyond
+        the header are zero-filled.
+      out_len: static output width L; frames past it are dropped (the
+        caller sizes L generously, e.g. ``int(sizes.sum(1).max()) ``).
+
+    Returns:
+      (buf, lens): uint8 [B, out_len] streams and int32 [B] byte
+      counts — exactly the inputs of :func:`..pipeline.wire_pipeline_step`.
+      The wire has no gaps, so absent frames are compacted away: a
+      later decode yields the emitted frames left-packed in order
+      (property-tested, including interleaved absent frames).
+    """
+    valid = body_sizes >= 16
+    sizes = jnp.where(valid, body_sizes, 0)
+    frame_sizes = jnp.where(valid, sizes + 4, 0)
+    ends = jnp.cumsum(frame_sizes, axis=1)
+    starts = ends - frame_sizes
+    fits = valid & (ends <= out_len)
+    lens = jnp.max(jnp.where(fits, ends, 0), axis=1).astype(jnp.int32)
+
+    # [B, F, 20] header byte values...
+    hdr = jnp.concatenate([
+        _be_bytes(sizes[..., None]),
+        _be_bytes(xid[..., None]),
+        _be_bytes(zxid_hi[..., None]),
+        _be_bytes(zxid_lo[..., None]),
+        _be_bytes(err[..., None]),
+    ], axis=-1)
+    # ...scattered at each frame's cumulative offset.
+    cols = starts[..., None] + jnp.arange(_HDR, dtype=jnp.int32)
+    B = xid.shape[0]
+    rows = jnp.broadcast_to(
+        jnp.arange(B, dtype=jnp.int32)[:, None, None], cols.shape)
+    cols = jnp.where(fits[..., None], cols, out_len)  # park dropped
+    buf = jnp.zeros((B, out_len + 1), jnp.uint8)
+    buf = buf.at[rows, cols].set(hdr, mode='drop')
+    return buf[:, :out_len], lens

@@ -48,13 +48,20 @@ class Logger:
             return
         # Render args BEFORE appending the context suffix: a context
         # value containing '%' (e.g. an IPv6 zone id in zkAddress) must
-        # not be interpreted as a format directive.
+        # not be interpreted as a format directive.  A format/arg
+        # mismatch must stay contained like stdlib logging's deferred
+        # formatting would — never raise into an FSM state handler.
         if args:
-            msg = msg % args
+            try:
+                msg = msg % args
+            except (TypeError, ValueError):
+                msg = '%s %r' % (msg, args)
         if self.context:
             msg += ' [%s]' % ' '.join(
                 '%s=%s' % (k, v) for k, v in self.context.items())
-        self.base.log(level, msg,
+        # stacklevel 3: hop over _log and the level-method wrapper so
+        # %(filename)s/%(lineno)d point at the real call site.
+        self.base.log(level, msg, stacklevel=3,
                       extra={'zk_context': dict(self.context)})
 
     def trace(self, msg: str, *args) -> None:
