@@ -16,7 +16,8 @@ the frame cursor across shard boundaries.
 """
 
 from .mesh import make_mesh
-from .sharded import sharded_wire_step
+from .sharded import sharded_wire_roundtrip, sharded_wire_step
 from .seqscan import seq_parallel_frame_scan
 
-__all__ = ['make_mesh', 'sharded_wire_step', 'seq_parallel_frame_scan']
+__all__ = ['make_mesh', 'sharded_wire_roundtrip',
+           'sharded_wire_step', 'seq_parallel_frame_scan']

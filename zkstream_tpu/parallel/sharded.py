@@ -20,6 +20,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..ops.bytesops import u64pair_reduce_max
+from ..ops.encode import build_reply_streams
 from ..ops.pipeline import WireStats, wire_pipeline_step
 
 
@@ -76,16 +77,44 @@ def sharded_wire_step(mesh: Mesh, max_frames: int = 32):
         local_step,
         mesh=mesh,
         in_specs=(P('dp', None), P('dp')),
-        out_specs=(
-            WireStats(
-                starts=P('dp', None), sizes=P('dp', None),
-                xids=P('dp', None), errs=P('dp', None),
-                n_frames=P('dp'), n_replies=P('dp'),
-                n_notifications=P('dp'), n_pings=P('dp'),
-                n_errors=P('dp'), max_zxid_hi=P('dp'),
-                max_zxid_lo=P('dp'), bad=P('dp'), resid=P('dp'),
-            ),
-            GlobalWireStats(P(), P(), P(), P(), P()),
-        ),
+        out_specs=(_WIRE_STATS_DP_SPEC,
+                   GlobalWireStats(P(), P(), P(), P(), P())),
+    )
+    return jax.jit(sharded)
+
+
+_WIRE_STATS_DP_SPEC = WireStats(
+    starts=P('dp', None), sizes=P('dp', None),
+    xids=P('dp', None), errs=P('dp', None),
+    n_frames=P('dp'), n_replies=P('dp'),
+    n_notifications=P('dp'), n_pings=P('dp'),
+    n_errors=P('dp'), max_zxid_hi=P('dp'),
+    max_zxid_lo=P('dp'), bad=P('dp'), resid=P('dp'),
+)
+
+
+def sharded_wire_roundtrip(mesh: Mesh, max_frames: int = 32,
+                           out_len: int = 1024):
+    """Build the jitted dp-sharded encode->decode loop for ``mesh``.
+
+    Each device encodes its shard of per-frame field planes into wire
+    streams (ops/encode.py) and immediately decodes them back
+    (ops/pipeline.py); the fleet-wide frame count psum-reduces over the
+    dp axis.  Returns ``loop(xid, zhi, zlo, err, sizes) ->
+    (WireStats, total_frames)`` with all plane inputs int32 [B, F], B
+    divisible by the dp axis size.
+    """
+
+    def local(xid, zhi, zlo, err, sizes):
+        buf, lens = build_reply_streams(xid, zhi, zlo, err, sizes,
+                                        out_len=out_len)
+        stats = wire_pipeline_step(buf, lens, max_frames=max_frames)
+        return stats, lax.psum(jnp.sum(stats.n_frames), 'dp')
+
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P('dp', None),) * 5,
+        out_specs=(_WIRE_STATS_DP_SPEC, P()),
     )
     return jax.jit(sharded)
