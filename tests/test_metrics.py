@@ -1,0 +1,58 @@
+"""Metrics tests: labelled counters, Prometheus exposition, and the
+client's event counter (the rebuild's artedi equivalent,
+reference: lib/client.js:29,58-61,222-235)."""
+
+import pytest
+
+from zkstream_tpu import Client, Collector
+from zkstream_tpu.server import ZKServer
+
+
+@pytest.fixture
+def server(event_loop):
+    srv = event_loop.run_until_complete(ZKServer().start())
+    yield srv
+    event_loop.run_until_complete(srv.stop())
+
+
+def test_counter_labels_and_exposition():
+    c = Collector()
+    ctr = c.counter('zookeeper_events', 'Total number of zookeeper events')
+    assert c.counter('zookeeper_events') is ctr  # idempotent
+    ctr.increment({'evtype': 'session'})
+    ctr.increment({'evtype': 'connect'})
+    ctr.increment({'evtype': 'connect'})
+    assert ctr.value({'evtype': 'connect'}) == 2
+    assert ctr.value({'evtype': 'session'}) == 1
+    assert ctr.value({'evtype': 'nope'}) == 0
+    text = c.expose()
+    assert '# HELP zookeeper_events Total number of zookeeper events' \
+        in text
+    assert '# TYPE zookeeper_events counter' in text
+    assert 'zookeeper_events{evtype="connect"} 2.0' in text
+
+
+async def test_client_counts_events_and_notifications(server):
+    """An injected collector sees zookeeper_events increments for the
+    session/connect lifecycle and zookeeper_notifications per watch
+    fire (reference counter names, lib/client.js:29,
+    lib/zk-session.js:25)."""
+    coll = Collector()
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, collector=coll)
+    c.start()
+    await c.wait_connected(timeout=5)
+    ev = coll.get_collector('zookeeper_events')
+    assert ev.value({'evtype': 'session'}) == 1
+    assert ev.value({'evtype': 'connect'}) == 1
+
+    await c.create('/m', b'a')
+    seen = []
+    c.watcher('/m').on('dataChanged', lambda d, s: seen.append(bytes(d)))
+    from helpers import wait_until
+    await wait_until(lambda: seen == [b'a'])
+    await c.set('/m', b'b')
+    await wait_until(lambda: seen == [b'a', b'b'])
+    notif = coll.get_collector('zookeeper_notifications')
+    assert notif.value({'event': 'dataChanged'}) >= 1
+    await c.close()

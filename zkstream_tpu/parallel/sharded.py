@@ -93,8 +93,8 @@ _WIRE_STATS_DP_SPEC = WireStats(
 )
 
 
-def sharded_wire_roundtrip(mesh: Mesh, max_frames: int = 32,
-                           out_len: int = 1024):
+def sharded_wire_roundtrip(mesh: Mesh, out_len: int,
+                           max_frames: int | None = None):
     """Build the jitted dp-sharded encode->decode loop for ``mesh``.
 
     Each device encodes its shard of per-frame field planes into wire
@@ -103,12 +103,18 @@ def sharded_wire_roundtrip(mesh: Mesh, max_frames: int = 32,
     dp axis.  Returns ``loop(xid, zhi, zlo, err, sizes) ->
     (WireStats, total_frames)`` with all plane inputs int32 [B, F], B
     divisible by the dp axis size.
+
+    ``out_len`` has no safe default: frames past it are dropped by the
+    encoder (its documented overflow contract), so the caller must size
+    it for their largest fleet row.  ``max_frames`` defaults to the
+    plane width F, which cannot under-decode.
     """
 
     def local(xid, zhi, zlo, err, sizes):
+        F = max_frames if max_frames is not None else sizes.shape[1]
         buf, lens = build_reply_streams(xid, zhi, zlo, err, sizes,
                                         out_len=out_len)
-        stats = wire_pipeline_step(buf, lens, max_frames=max_frames)
+        stats = wire_pipeline_step(buf, lens, max_frames=F)
         return stats, lax.psum(jnp.sum(stats.n_frames), 'dp')
 
     sharded = shard_map(
