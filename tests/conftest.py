@@ -30,6 +30,17 @@ def event_loop():
     loop.close()
 
 
+@pytest.fixture
+def server(event_loop):
+    """One in-process ZK server per test (shared by the single-server
+    integration suites)."""
+    from zkstream_tpu.server import ZKServer
+
+    srv = event_loop.run_until_complete(ZKServer().start())
+    yield srv
+    event_loop.run_until_complete(srv.stop())
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests on the test's event_loop fixture."""

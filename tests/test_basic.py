@@ -7,14 +7,6 @@ import asyncio
 import pytest
 
 from zkstream_tpu import Client, CreateFlag, ZKError, ZKNotConnectedError
-from zkstream_tpu.server import ZKServer
-
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
-
 
 @pytest.fixture
 def client(event_loop, server):

@@ -16,14 +16,6 @@ from helpers import wait_until
 from zkstream_tpu import Client
 from zkstream_tpu.io import watcher as watcher_mod
 from zkstream_tpu.io.watcher import LostWakeupError
-from zkstream_tpu.server import ZKServer
-
-
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
 
 
 @pytest.fixture

@@ -5,11 +5,8 @@ lib/connection-fsm.js:93-96,209-211, lib/zk-session.js:179-181)."""
 
 import logging
 
-import pytest
-
 from helpers import wait_until
 from zkstream_tpu import Client, Logger
-from zkstream_tpu.server import ZKServer
 
 
 class _Capture(logging.Handler):
@@ -19,13 +16,6 @@ class _Capture(logging.Handler):
 
     def emit(self, record):
         self.records.append(record)
-
-
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
 
 
 def test_child_merges_context():

@@ -2,17 +2,7 @@
 client's event counter (the rebuild's artedi equivalent,
 reference: lib/client.js:29,58-61,222-235)."""
 
-import pytest
-
 from zkstream_tpu import Client, Collector
-from zkstream_tpu.server import ZKServer
-
-
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
 
 
 def test_counter_labels_and_exposition():

@@ -12,13 +12,6 @@ from zkstream_tpu.server import ZKServer
 from helpers import wait_until
 
 
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
-
-
 def tracked_client(server, **kw):
     kw.setdefault('session_timeout', 5000)
     c = Client(address='127.0.0.1', port=server.port, **kw)

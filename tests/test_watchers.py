@@ -7,14 +7,6 @@ import pytest
 
 from helpers import wait_until
 from zkstream_tpu import Client
-from zkstream_tpu.server import ZKServer
-
-
-@pytest.fixture
-def server(event_loop):
-    srv = event_loop.run_until_complete(ZKServer().start())
-    yield srv
-    event_loop.run_until_complete(srv.stop())
 
 
 @pytest.fixture
