@@ -1,0 +1,133 @@
+"""CLI tests: ``python -m zkstream_tpu`` commands driven in-process
+against the in-process server (the rebuild's zkCli analogue)."""
+
+import asyncio
+
+import pytest
+
+from zkstream_tpu import Client, cli
+
+
+async def run_cli(server, *argv, capsys=None):
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:%d' % server.port,
+         '--session-timeout', '5000'] + list(argv))
+    rc = await cli._run(args)
+    if capsys is None:
+        return rc, '', ''
+    out, err = capsys.readouterr()
+    return rc, out, err
+
+
+async def test_cli_crud_cycle(server, capsys):
+    rc, out, _ = await run_cli(server, 'ping', capsys=capsys)
+    assert rc == 0 and out.startswith('ping ok:')
+
+    rc, out, _ = await run_cli(server, 'create', '/c', 'hello',
+                               capsys=capsys)
+    assert rc == 0 and out.strip() == '/c'
+
+    rc, out, _ = await run_cli(server, 'get', '/c', capsys=capsys)
+    assert rc == 0 and out == 'hello\n'
+
+    rc, out, _ = await run_cli(server, 'set', '/c', 'world',
+                               capsys=capsys)
+    assert rc == 0 and out.strip() == 'version = 1'
+
+    rc, out, _ = await run_cli(server, 'stat', '/c', capsys=capsys)
+    assert rc == 0
+    assert 'version = 1' in out and 'dataLength = 5' in out
+
+    rc, out, _ = await run_cli(server, 'getacl', '/c', capsys=capsys)
+    assert rc == 0 and 'world:anyone' in out
+
+    rc, out, _ = await run_cli(server, 'create', '-p', '/d/e/f', 'x',
+                               capsys=capsys)
+    assert rc == 0 and out.strip() == '/d/e/f'
+
+    rc, out, _ = await run_cli(server, 'ls', '/', capsys=capsys)
+    assert rc == 0 and out.split() == ['c', 'd']
+
+    rc, out, _ = await run_cli(server, 'sync', '/', capsys=capsys)
+    assert rc == 0
+
+    rc, _, _ = await run_cli(server, 'delete', '/c', capsys=capsys)
+    assert rc == 0
+    rc, _, err = await run_cli(server, 'get', '/c', capsys=capsys)
+    assert rc == 1 and 'NO_NODE' in err
+
+
+async def test_cli_sequential_create(server, capsys):
+    rc, out, _ = await run_cli(server, 'create', '-q', '/s-',
+                               capsys=capsys)
+    assert rc == 0 and out.strip() == '/s-0000000000'
+
+
+async def test_cli_error_exit_status(server, capsys):
+    rc, _, err = await run_cli(server, 'delete', '/nope',
+                               capsys=capsys)
+    assert rc == 1
+    assert 'NO_NODE' in err
+
+
+async def test_cli_watch_count(server, capsys):
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    await c.wait_connected(timeout=5)
+    await c.create('/w', b'v0')
+
+    async def poke():
+        await asyncio.sleep(0.3)
+        await c.set('/w', b'v1')
+
+    task = asyncio.get_event_loop().create_task(poke())
+    # Arming emits the initial state first — created (existence watch),
+    # dataChanged v0, childrenChanged [] in registration order — then
+    # the set delivers dataChanged v1.
+    rc, out, _ = await run_cli(server, 'watch', '/w', '--count', '4',
+                               capsys=capsys)
+    await task
+    assert rc == 0
+    lines = out.strip().splitlines()
+    assert lines[:3] == ['created /w', "dataChanged /w b'v0'",
+                         'childrenChanged /w []']
+    assert lines[3] == "dataChanged /w b'v1'"
+    await c.close()
+
+
+async def test_cli_connect_failure_timeout(capsys):
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:1', '--timeout', '0.5', 'ping'])
+    rc = await cli._run(args)
+    _, err = capsys.readouterr()
+    assert rc == 1 and 'could not connect' in err
+
+
+async def test_cli_connect_failure_policy_exhausted(capsys):
+    """With a long --timeout the pool exhausts its retry policy first
+    and wait_connected raises ZKNotConnectedError (a ZKProtocolError,
+    not a ZKError) — still a clean exit 1, not a traceback."""
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:1', '--timeout', '15', 'ping'])
+    rc = await cli._run(args)
+    _, err = capsys.readouterr()
+    assert rc == 1 and 'could not connect' in err
+
+
+def test_cli_server_spec_parsing(capsys):
+    parse = cli._parse_servers
+    assert parse('h') == [{'address': 'h', 'port': 2181}]
+    assert parse('h:1234') == [{'address': 'h', 'port': 1234}]
+    assert parse('a:1,b:2') == [{'address': 'a', 'port': 1},
+                                {'address': 'b', 'port': 2}]
+    # bare IPv6 literal is a host, not a host:port split
+    assert parse('::1') == [{'address': '::1', 'port': 2181}]
+    assert parse('[::1]:99') == [{'address': '::1', 'port': 99}]
+    assert parse('[fe80::2]') == [{'address': 'fe80::2', 'port': 2181}]
+    # malformed specs are argparse usage errors (exit 2), not tracebacks
+    for bad in ('h:', 'h:abc', ':9', 'h:0', 'h:99999', '[::1', ''):
+        with pytest.raises(SystemExit) as ei:
+            cli.build_parser().parse_args(['-s', bad, 'ping'])
+        assert ei.value.code == 2
+        capsys.readouterr()

@@ -1,0 +1,7 @@
+"""``python -m zkstream_tpu`` entry point (see cli.py)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

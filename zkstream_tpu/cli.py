@@ -1,0 +1,235 @@
+"""Command-line client: ``python -m zkstream_tpu <cmd> ...``.
+
+The reference ecosystem's workflow leans on the Apache ``zkCli`` for
+poking at a ZooKeeper tree (the reference's own tests shell out to it
+for cross-validation, test/zkserver.js:72-164); this is the rebuild's
+equivalent, built on the public ``Client``.
+
+Commands: ls, get, set, create, delete, stat, getacl, sync, ping,
+watch.  Exit status 0 on success, 1 on a ZooKeeper error (message on
+stderr), 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import sys
+
+from .client import Client
+from .protocol.consts import CreateFlag
+from .protocol.errors import ZKError, ZKProtocolError
+from .protocol.records import Stat
+
+
+def _parse_servers(value: str) -> list[dict]:
+    """--server argument type: ``host[:port][,host[:port]...]`` with
+    ``[v6addr]:port`` brackets; a bare IPv6 literal is a host.  Raises
+    ArgumentTypeError (argparse usage error, exit 2) on bad specs."""
+    servers = []
+    for spec in value.split(','):
+        spec = spec.strip()
+        try:
+            if spec.startswith('['):
+                host, sep, rest = spec[1:].partition(']')
+                if not sep or (rest and not rest.startswith(':')):
+                    raise ValueError('bad [v6]:port syntax')
+                port = int(rest[1:]) if rest else 2181
+            elif spec.count(':') == 1:
+                host, port_s = spec.split(':')
+                port = int(port_s)
+            else:  # bare hostname, IPv4, or bare IPv6 literal
+                host, port = spec, 2181
+            if not host or not 0 < port < 65536:
+                raise ValueError('empty host or port out of range')
+        except ValueError as e:
+            raise argparse.ArgumentTypeError(
+                'invalid server spec %r: %s' % (spec, e))
+        servers.append({'address': host, 'port': port})
+    return servers
+
+
+def _print_stat(stat: Stat) -> None:
+    for field in dataclasses.fields(Stat):
+        print('%s = %s' % (field.name, getattr(stat, field.name)))
+
+
+async def _run(args) -> int:
+    addrs = ','.join('%s:%d' % (s['address'], s['port'])
+                     for s in args.server)
+    client = Client(servers=args.server,
+                    session_timeout=args.session_timeout)
+    client.start()
+    try:
+        try:
+            await client.wait_connected(timeout=args.timeout)
+        except (TimeoutError, asyncio.TimeoutError, ZKProtocolError):
+            # timeout, or the pool exhausted its retry policy (failed)
+            print('error: could not connect to %s' % (addrs,),
+                  file=sys.stderr)
+            return 1
+        return await _dispatch(client, args)
+    except (ZKError, ZKProtocolError) as e:
+        print('error: %s (%s)' % (e.message, e.code), file=sys.stderr)
+        return 1
+    finally:
+        await client.close()
+
+
+async def _dispatch(client: Client, args) -> int:
+    cmd = args.cmd
+    if cmd == 'ping':
+        latency = await client.ping()
+        print('ping ok: %.1f ms' % (latency,))
+    elif cmd == 'ls':
+        children, stat = await client.list(args.path)
+        for name in sorted(children):
+            print(name)
+        if args.stat:
+            _print_stat(stat)
+    elif cmd == 'get':
+        data, stat = await client.get(args.path)
+        out = sys.stdout.buffer
+        out.write(data)
+        if data and not data.endswith(b'\n'):
+            out.write(b'\n')
+        out.flush()
+        if args.stat:
+            _print_stat(stat)
+    elif cmd == 'stat':
+        _print_stat(await client.stat(args.path))
+    elif cmd == 'getacl':
+        for acl in await client.get_acl(args.path):
+            perms = '|'.join(sorted(p.name for p in acl.perms))
+            print('%s:%s = %s' % (acl.id.scheme, acl.id.id, perms))
+    elif cmd == 'create':
+        flags = CreateFlag(0)
+        if args.ephemeral:
+            flags |= CreateFlag.EPHEMERAL
+        if args.sequential:
+            flags |= CreateFlag.SEQUENTIAL
+        data = args.data.encode() if args.data is not None else b''
+        if args.parents:
+            path = await client.create_with_empty_parents(
+                args.path, data, flags=flags)
+        else:
+            path = await client.create(args.path, data, flags=flags)
+        print(path)
+        if args.ephemeral:
+            # An ephemeral dies with its session: hold it until EOF so
+            # the invocation is actually observable from elsewhere.
+            print('holding ephemeral until EOF (ctrl-d) ...',
+                  file=sys.stderr)
+            await asyncio.get_event_loop().run_in_executor(
+                None, sys.stdin.read)
+    elif cmd == 'set':
+        stat = await client.set(args.path, args.data.encode(),
+                                version=args.version)
+        print('version = %d' % (stat.version,))
+    elif cmd == 'delete':
+        await client.delete(args.path, args.version)
+    elif cmd == 'sync':
+        await client.sync(args.path)
+    elif cmd == 'watch':
+        return await _watch(client, args)
+    else:  # pragma: no cover - argparse enforces choices
+        raise AssertionError(cmd)
+    return 0
+
+
+async def _watch(client: Client, args) -> int:
+    stop: asyncio.Future = asyncio.get_event_loop().create_future()
+    seen = [0]
+
+    def fire(evt):
+        def cb(*a):
+            extra = ''
+            if evt == 'dataChanged' and a:
+                extra = ' %r' % (bytes(a[0]),)
+            elif evt == 'childrenChanged' and a:
+                extra = ' %s' % (sorted(a[0]),)
+            print('%s %s%s' % (evt, args.path, extra), flush=True)
+            seen[0] += 1
+            if args.count and seen[0] >= args.count and not stop.done():
+                stop.set_result(None)
+        return cb
+
+    w = client.watcher(args.path)
+    for evt in ('created', 'deleted', 'dataChanged', 'childrenChanged'):
+        w.on(evt, fire(evt))
+    client.on('expire', lambda *a: stop.done() or
+              stop.set_exception(RuntimeError('session expired')))
+    try:
+        await stop
+    except RuntimeError as e:
+        print('error: %s' % (e,), file=sys.stderr)
+        return 1
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog='python -m zkstream_tpu',
+        description='ZooKeeper command-line client (zkstream_tpu)')
+    p.add_argument('--server', '-s', type=_parse_servers,
+                   default=[{'address': '127.0.0.1', 'port': 2181}],
+                   help='host[:port][,host[:port]...]; [v6]:port for '
+                        'IPv6 (default 127.0.0.1:2181)')
+    p.add_argument('--session-timeout', type=int, default=30000,
+                   help='ZK session timeout, ms')
+    p.add_argument('--timeout', type=float, default=10.0,
+                   help='connect timeout, seconds')
+    sub = p.add_subparsers(dest='cmd', required=True)
+
+    sub.add_parser('ping', help='round-trip a ping')
+
+    ls = sub.add_parser('ls', help='list children')
+    ls.add_argument('path')
+    ls.add_argument('--stat', action='store_true',
+                    help='also print the Stat')
+
+    get = sub.add_parser('get', help='print node data')
+    get.add_argument('path')
+    get.add_argument('--stat', action='store_true')
+
+    st = sub.add_parser('stat', help='print the Stat record')
+    st.add_argument('path')
+
+    ga = sub.add_parser('getacl', help='print the ACL list')
+    ga.add_argument('path')
+
+    cr = sub.add_parser('create', help='create a node')
+    cr.add_argument('path')
+    cr.add_argument('data', nargs='?', default=None)
+    cr.add_argument('--ephemeral', '-e', action='store_true')
+    cr.add_argument('--sequential', '-q', action='store_true')
+    cr.add_argument('--parents', '-p', action='store_true',
+                    help='create missing parents (persistent, b"null")')
+
+    se = sub.add_parser('set', help='set node data')
+    se.add_argument('path')
+    se.add_argument('data')
+    se.add_argument('--version', '-v', type=int, default=-1)
+
+    de = sub.add_parser('delete', help='delete a node')
+    de.add_argument('path')
+    de.add_argument('--version', '-v', type=int, default=-1)
+
+    sy = sub.add_parser('sync', help='sync a path with the leader')
+    sy.add_argument('path')
+
+    wa = sub.add_parser('watch', help='stream watch events for a path')
+    wa.add_argument('path')
+    wa.add_argument('--count', '-n', type=int, default=0,
+                    help='exit after N events (default: forever)')
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == '__main__':  # pragma: no cover - exercised via __main__
+    sys.exit(main())
