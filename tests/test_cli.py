@@ -115,6 +115,22 @@ async def test_cli_connect_failure_policy_exhausted(capsys):
     assert rc == 1 and 'could not connect' in err
 
 
+async def test_cli_bad_path_is_usage_error(server, capsys):
+    """A path without a leading slash is a clean exit-2 usage error,
+    not a traceback."""
+    rc, _, err = await run_cli(server, 'get', 'foo', capsys=capsys)
+    assert rc == 2
+    assert 'usage error' in err and 'foo' in err
+
+
+def test_cli_import_main_is_inert():
+    """Importing zkstream_tpu.__main__ must not run the CLI or exit."""
+    import importlib
+
+    mod = importlib.import_module('zkstream_tpu.__main__')
+    assert hasattr(mod, 'main')
+
+
 def test_cli_server_spec_parsing(capsys):
     parse = cli._parse_servers
     assert parse('h') == [{'address': 'h', 'port': 2181}]

@@ -5,6 +5,26 @@ session state machines, watcher engine with lost-wakeup self-checking,
 ensemble failover with session resumption), plus an in-process ZooKeeper
 server for tests.
 
+Layer map (the analogue of the reference's overview diagram,
+lib/index.js:14-54; see PARITY.md for the full component table)::
+
+    client.py            Client — public API facade, event surface
+      |                    (FSM: normal/closing/closed)
+    io/pool.py           ConnectionPool — backend set, retry policy,
+      |                    decoherence rebalance (cueball equivalent)
+    io/connection.py     ZKConnection — one TCP connection's lifecycle,
+      |   \\                xids, pending requests, ping keepalive
+      |    io/session.py ZKSession — the durable session (peer of the
+      |    io/watcher.py   connection, attaches to whichever is live);
+      |                    ZKWatcher/ZKWatchEvent re-arm engine
+    protocol/framing.py  FrameDecoder/PacketCodec — length-prefixed
+      |                    framing, symmetric client/server mode
+    protocol/records.py  message bodies, special-XID dispatch, Stat/ACL
+    protocol/jute.py     Jute primitive codec
+    protocol/consts.py   opcodes, error codes, perms, XIDs
+    utils/               FSM base, events, metrics, logging, native
+    ops/ parallel/       the TPU data plane: batched/sharded wire codec
+
 The reference (mounted at /root/reference) is pure JavaScript with zero
 native components and no ML workload; see SURVEY.md and BASELINE.json for
 the structural analysis.

@@ -73,6 +73,11 @@ async def _run(args) -> int:
     except (ZKError, ZKProtocolError) as e:
         print('error: %s (%s)' % (e.message, e.code), file=sys.stderr)
         return 1
+    except (ValueError, TypeError) as e:
+        # argument validation from the client API (bad path, bad
+        # version...) is a usage error, not a crash
+        print('usage error: %s' % (e,), file=sys.stderr)
+        return 2
     finally:
         await client.close()
 
@@ -118,11 +123,23 @@ async def _dispatch(client: Client, args) -> int:
         print(path)
         if args.ephemeral:
             # An ephemeral dies with its session: hold it until EOF so
-            # the invocation is actually observable from elsewhere.
+            # the invocation is actually observable from elsewhere.  A
+            # DAEMON thread (not the default executor) watches stdin so
+            # ctrl-c exits promptly instead of hanging on executor join.
             print('holding ephemeral until EOF (ctrl-d) ...',
                   file=sys.stderr)
-            await asyncio.get_event_loop().run_in_executor(
-                None, sys.stdin.read)
+            import threading
+            loop = asyncio.get_event_loop()
+            eof: asyncio.Future = loop.create_future()
+
+            def _stdin_eof():
+                try:
+                    sys.stdin.read()
+                finally:
+                    loop.call_soon_threadsafe(
+                        lambda: eof.done() or eof.set_result(None))
+            threading.Thread(target=_stdin_eof, daemon=True).start()
+            await eof
     elif cmd == 'set':
         stat = await client.set(args.path, args.data.encode(),
                                 version=args.version)
