@@ -56,6 +56,19 @@ def _print_stat(stat: Stat) -> None:
 
 
 async def _run(args) -> int:
+    # Validate user arguments BEFORE connecting, with the same checks
+    # the client API applies, so bad input is a clean exit-2 usage
+    # error while later ValueErrors (e.g. a malformed server reply)
+    # still surface as real errors.
+    try:
+        if getattr(args, 'path', None) is not None:
+            Client._check_path(args.path)
+        if getattr(args, 'version', None) is not None:
+            Client._check_version(args.version)
+    except (ValueError, TypeError) as e:
+        print('usage error: %s' % (e,), file=sys.stderr)
+        return 2
+
     addrs = ','.join('%s:%d' % (s['address'], s['port'])
                      for s in args.server)
     client = Client(servers=args.server,
@@ -73,11 +86,6 @@ async def _run(args) -> int:
     except (ZKError, ZKProtocolError) as e:
         print('error: %s (%s)' % (e.message, e.code), file=sys.stderr)
         return 1
-    except (ValueError, TypeError) as e:
-        # argument validation from the client API (bad path, bad
-        # version...) is a usage error, not a crash
-        print('usage error: %s' % (e,), file=sys.stderr)
-        return 2
     finally:
         await client.close()
 

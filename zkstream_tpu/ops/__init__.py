@@ -25,6 +25,7 @@ connection streams can be decoded in one fused XLA computation:
   codec's isServer encode mode, lib/zk-streams.js:121-148).
 """
 
+from .bodies import slice_frame_bodies
 from .encode import build_reply_streams
 from .bytesops import (
     be_i32_at,
@@ -44,6 +45,7 @@ from .pipeline import WireStats, wire_pipeline_step
 __all__ = [
     'MAX_PACKET',
     'build_reply_streams',
+    'slice_frame_bodies',
     'be_i32_at',
     'be_i64pair_at',
     'u64pair_max',

@@ -1,0 +1,49 @@
+"""Batched frame-body extraction: the last step of a full decode.
+
+After :mod:`frame_scan` locates frames and :mod:`headers` parses the
+16-byte reply headers, consumers that want the opcode-specific payload
+need the body bytes themselves (what the scalar codec hands to
+``records.read_response``, reference: lib/zk-streams.js:74-79).  This
+op slices every frame's body out of the stream batch into a dense
+padded tensor in one gather — no per-frame host loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def slice_frame_bodies(buf, starts, sizes, max_body: int,
+                       skip_header: bool = False):
+    """Gather frame bodies into a padded [B, F, max_body] tensor.
+
+    Args:
+      buf: uint8 [B, L] stream bytes.
+      starts: int32 [B, F] body start offsets (-1 = no frame), as
+        produced by the scans / the Pallas kernel.
+      sizes: int32 [B, F] body byte counts.
+      max_body: static width of the output's trailing axis; longer
+        bodies are truncated to it (callers size it from the protocol,
+        e.g. 16 + max payload; truncation is visible via ``sizes``).
+      skip_header: drop the leading 16-byte reply header, yielding just
+        the opcode-specific payload (sizes still count the header, as
+        on the wire).
+
+    Returns:
+      (bodies, mask): uint8 [B, F, max_body] zero-padded bytes and
+      bool [B, F, max_body] validity mask.
+    """
+    B, L = buf.shape
+    hdr = 16 if skip_header else 0
+    valid = starts >= 0
+    base = jnp.where(valid, starts, 0) + hdr
+    pos = jnp.arange(max_body, dtype=jnp.int32)
+    # [B, F, max_body] absolute byte positions, clamped in-bounds;
+    # the mask kills reads past each frame's real extent.
+    idx = base[..., None] + pos
+    mask = valid[..., None] & (pos < (sizes[..., None] - hdr)) & \
+        (idx < L)
+    idx = jnp.clip(idx, 0, L - 1)
+    bodies = jnp.take_along_axis(
+        buf[:, None, :], jnp.where(mask, idx, 0), axis=2)
+    return jnp.where(mask, bodies, 0).astype(jnp.uint8), mask
