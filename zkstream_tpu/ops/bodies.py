@@ -43,7 +43,8 @@ def slice_frame_bodies(buf, starts, sizes, max_body: int,
     idx = base[..., None] + pos
     mask = valid[..., None] & (pos < (sizes[..., None] - hdr)) & \
         (idx < L)
-    idx = jnp.clip(idx, 0, L - 1)
+    # where(mask, idx, 0) is the single bounds mechanism: every index
+    # the mask rejects gathers from position 0 and is zeroed after.
     bodies = jnp.take_along_axis(
         buf[:, None, :], jnp.where(mask, idx, 0), axis=2)
     return jnp.where(mask, bodies, 0).astype(jnp.uint8), mask
