@@ -16,8 +16,10 @@ the frame cursor across shard boundaries.
 """
 
 from .mesh import make_mesh
+from .multihost import host_local_wire_batch, initialize
 from .sharded import sharded_wire_roundtrip, sharded_wire_step
 from .seqscan import seq_parallel_frame_scan
 
-__all__ = ['make_mesh', 'sharded_wire_roundtrip',
-           'sharded_wire_step', 'seq_parallel_frame_scan']
+__all__ = ['host_local_wire_batch', 'initialize', 'make_mesh',
+           'sharded_wire_roundtrip', 'sharded_wire_step',
+           'seq_parallel_frame_scan']

@@ -1,0 +1,63 @@
+"""Multi-host (DCN) entry points for the sharded data plane.
+
+One process per host, ICI inside a host, DCN between hosts — the
+standard JAX multi-controller layout.  The single-host mesh code in
+this package works unchanged once three things hold:
+
+1. every process has called :func:`initialize` (jax.distributed — the
+   coordinator barrier, global device enumeration);
+2. the mesh is built over ``jax.devices()`` (GLOBAL devices — the
+   default in :func:`make_mesh`), with the ``dp`` axis ordered so that
+   a stream batch's shards land on the devices of the host that
+   accepted those connections (ICI does the reductions inside a host;
+   only the scalar psum/pmax results cross DCN);
+3. per-host inputs are assembled into global arrays with
+   :func:`host_local_wire_batch` rather than shipped to one host.
+
+The reference has no analogue — its "distributed backend" is a TCP
+client pool against a server ensemble (SURVEY.md §5) — but a fleet
+proxy decoding connection streams on every host of a pod slice is the
+scale story this framework is built for.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or start) the multi-controller cluster.
+
+    Thin passthrough to ``jax.distributed.initialize`` with the same
+    auto-detection behavior (env vars / cloud metadata when arguments
+    are omitted).  Call once per process, before any other JAX use.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def host_local_wire_batch(mesh: Mesh, local_buf, local_lens):
+    """Assemble per-host stream batches into dp-sharded global arrays.
+
+    Each host passes the [b, L] uint8 bytes and [b] int32 lengths of
+    ITS OWN connections (b = global B / process_count); the returned
+    global arrays are sharded over the mesh's ``dp`` axis without any
+    cross-host data movement — each host's shard stays on its devices
+    (``jax.make_array_from_process_local_data``).  Feed them straight
+    to ``sharded_wire_step(mesh, ...)``.
+    """
+    local_buf = np.ascontiguousarray(local_buf)
+    local_lens = np.ascontiguousarray(local_lens)
+    buf_sharding = NamedSharding(mesh, P('dp', None))
+    len_sharding = NamedSharding(mesh, P('dp'))
+    gbuf = jax.make_array_from_process_local_data(
+        buf_sharding, local_buf)
+    glens = jax.make_array_from_process_local_data(
+        len_sharding, local_lens)
+    return gbuf, glens
