@@ -142,7 +142,10 @@ def test_cli_server_spec_parsing(capsys):
     assert parse('[::1]:99') == [{'address': '::1', 'port': 99}]
     assert parse('[fe80::2]') == [{'address': 'fe80::2', 'port': 2181}]
     # malformed specs are argparse usage errors (exit 2), not tracebacks
-    for bad in ('h:', 'h:abc', ':9', 'h:0', 'h:99999', '[::1', ''):
+    # multi-colon specs that are not IPv6 literals are typos
+    # (host:port:junk, missing comma), not hostnames
+    for bad in ('h:', 'h:abc', ':9', 'h:0', 'h:99999', '[::1', '',
+                'host:2181:junk', 'a:1:b:2'):
         with pytest.raises(SystemExit) as ei:
             cli.build_parser().parse_args(['-s', bad, 'ping'])
         assert ei.value.code == 2

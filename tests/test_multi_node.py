@@ -168,18 +168,19 @@ async def test_session_migration_revert_on_failure(ensemble):
         writer.transport.abort()
     fake = await asyncio.start_server(
         handler, '127.0.0.1', ensemble.servers[0].port)
-
-    await wait_until(
-        lambda: 'reattaching' in states and states[-1] == 'attached',
-        timeout=10)
-    assert c.session.session_id == sid
-    assert c.is_connected()
-    assert c.current_connection().backend.key == fallback
-    await c.ping()
-
-    # Swap the fake for the real member: migration now succeeds.
-    fake.close()
-    await fake.wait_closed()
+    try:
+        await wait_until(
+            lambda: 'reattaching' in states and states[-1] == 'attached',
+            timeout=10)
+        assert c.session.session_id == sid
+        assert c.is_connected()
+        assert c.current_connection().backend.key == fallback
+        await c.ping()
+    finally:
+        # Close even on timeout/assert failure, or the socket leaks
+        # into later tests that reuse the ensemble ports.
+        fake.close()
+        await fake.wait_closed()
     await ensemble.restart(0)
     await wait_until(
         lambda: c.is_connected() and

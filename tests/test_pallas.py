@@ -110,11 +110,28 @@ def test_pallas_empty_and_full_rows():
     assert int(got.n_frames[1]) == 1 and bool(got.bad[2])
 
 
-def test_vmem_guard_and_fallback():
+def test_vmem_limit_env_override(monkeypatch):
+    """ZKSTREAM_PALLAS_VMEM_BYTES overrides the guard ceiling at import
+    time; malformed or non-positive values warn and keep the default."""
+    from zkstream_tpu.ops import pallas_scan
+
+    monkeypatch.setenv('ZKSTREAM_PALLAS_VMEM_BYTES', '33554432')
+    assert pallas_scan._read_vmem_limit() == 33554432
+    for bad in ('32M', '0', '-1'):
+        monkeypatch.setenv('ZKSTREAM_PALLAS_VMEM_BYTES', bad)
+        with pytest.warns(UserWarning, match='ZKSTREAM_PALLAS_VMEM'):
+            assert pallas_scan._read_vmem_limit() == 16 * 1024 * 1024
+
+
+def test_vmem_guard_and_fallback(monkeypatch):
     """Shapes whose kernel would blow the scoped-VMEM limit must raise
     a clear error from pallas_wire_scan, and wire_pipeline_step_pallas
     must transparently fall back to the jnp pipeline for them."""
+    from zkstream_tpu.ops import pallas_scan
     from zkstream_tpu.ops.pallas_scan import fits_vmem, pallas_wire_scan
+
+    # the assertions below encode the default 16 MiB ceiling
+    monkeypatch.setattr(pallas_scan, '_VMEM_LIMIT', 16 * 1024 * 1024)
 
     assert fits_vmem(256, 5000, max_frames=48, block_rows=128)
     # observed Mosaic stack OOMs: R=256 x Lp~5120 and R=128 x Lp~13568

@@ -39,7 +39,19 @@ def _parse_servers(value: str) -> list[dict]:
             elif spec.count(':') == 1:
                 host, port_s = spec.split(':')
                 port = int(port_s)
-            else:  # bare hostname, IPv4, or bare IPv6 literal
+            elif spec.count(':') >= 2:
+                # Only a genuine IPv6 literal may contain multiple
+                # colons; anything else (host:2181:junk, a missing
+                # comma) is a usage error, not a hostname.
+                import ipaddress
+                try:
+                    ipaddress.IPv6Address(spec)
+                except ValueError:
+                    raise ValueError(
+                        'multiple colons but not an IPv6 literal '
+                        '(use [v6addr]:port, or a comma between specs)')
+                host, port = spec, 2181
+            else:  # bare hostname or IPv4
                 host, port = spec, 2181
             if not host or not 0 < port < 65536:
                 raise ValueError('empty host or port out of range')
@@ -137,7 +149,7 @@ async def _dispatch(client: Client, args) -> int:
             print('holding ephemeral until EOF (ctrl-d) ...',
                   file=sys.stderr)
             import threading
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             eof: asyncio.Future = loop.create_future()
 
             def _stdin_eof():
@@ -164,7 +176,7 @@ async def _dispatch(client: Client, args) -> int:
 
 
 async def _watch(client: Client, args) -> int:
-    stop: asyncio.Future = asyncio.get_event_loop().create_future()
+    stop: asyncio.Future = asyncio.get_running_loop().create_future()
     seen = [0]
 
     def fire(evt):

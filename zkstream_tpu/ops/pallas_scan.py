@@ -114,8 +114,32 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-#: TPU v5e scoped-VMEM ceiling for one kernel program.
-_VMEM_LIMIT = 16 * 1024 * 1024
+def _read_vmem_limit() -> int:
+    """Per-program scoped-VMEM ceiling used by the compile guard.
+
+    Defaults to the 16 MiB budget calibrated on v5e; other TPU
+    generations (or future Mosaic versions) may allow more, so the
+    guard is overridable via ``ZKSTREAM_PALLAS_VMEM_BYTES``.  Read
+    once at import: ``pallas_wire_scan`` is jitted, so a per-call read
+    would only take effect at first trace per shape and could diverge
+    from ``fits_vmem``."""
+    import os
+    import warnings
+    env = os.environ.get('ZKSTREAM_PALLAS_VMEM_BYTES')
+    if env:
+        try:
+            val = int(env)
+        except ValueError:
+            val = -1
+        if val > 0:
+            return val
+        warnings.warn(
+            'ignoring ZKSTREAM_PALLAS_VMEM_BYTES=%r (must be a '
+            'positive integer byte count); using 16 MiB' % (env,))
+    return 16 * 1024 * 1024
+
+
+_VMEM_LIMIT = _read_vmem_limit()
 
 
 def _vmem_estimate(R: int, Lp: int, max_frames: int) -> int:
