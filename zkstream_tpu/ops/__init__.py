@@ -41,6 +41,14 @@ from .frame_scan import (
 )
 from .headers import parse_reply_headers, stream_stats
 from .pipeline import WireStats, wire_pipeline_step
+from .replies import (
+    ReplyBodies,
+    StatPlanes,
+    parse_reply_bodies,
+    parse_stats,
+    slice_var_bytes,
+    stat_from_planes,
+)
 
 __all__ = [
     'MAX_PACKET',
@@ -57,4 +65,10 @@ __all__ = [
     'stream_stats',
     'WireStats',
     'wire_pipeline_step',
+    'ReplyBodies',
+    'StatPlanes',
+    'parse_reply_bodies',
+    'parse_stats',
+    'slice_var_bytes',
+    'stat_from_planes',
 ]

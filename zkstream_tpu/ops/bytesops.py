@@ -101,3 +101,12 @@ def u64pair_to_int(h, l) -> int:
     l = (np.asarray(l).astype(np.int64) & 0xFFFFFFFF).astype(np.uint64)
     out = (h << np.uint64(32)) | l
     return int(out) if out.ndim == 0 else out
+
+
+def i64pair_to_int(h, l) -> int:
+    """Host-side: collapse a (hi, lo) pair to the SIGNED int64 the wire
+    carries — the scalar codec's ``read_long`` is ``>q``
+    (reference long fields are signed, lib/jute-buffer.js:63-77)."""
+    out = np.asarray(u64pair_to_int(h, l), dtype=np.uint64)
+    signed = out.view(np.int64)
+    return int(signed) if signed.ndim == 0 else signed

@@ -1,0 +1,250 @@
+"""Batched opcode-specific reply-body decode.
+
+The scalar codec parses each reply body with a per-opcode reader
+(``records.read_response``; reference: lib/zk-buffer.js:281-370).  This
+module is the tensor restatement for the body layouts that are fixed
+offset or single-variable-field — which covers every reply the hot path
+cares about:
+
+- ``EXISTS`` / ``SET_DATA``: a bare 68-byte Stat record
+  (reference: lib/zk-buffer.js:428-442);
+- ``GET_DATA``: buffer(data) then Stat (lib/zk-buffer.js:353-357);
+- ``CREATE``: ustring path (lib/zk-buffer.js:333-335);
+- ``NOTIFICATION``: type:int32, state:int32, path ustring
+  (lib/zk-buffer.js:364-370).
+
+List-shaped bodies (children lists, ACL lists) stay on the scalar
+decoder: their layout is a length-prefixed *sequence of variable-width
+records*, which has no fixed-shape tensor form worth the gather storm.
+
+Dispatch strategy: rather than routing frames by opcode on device
+(dynamic control flow XLA can't tile), :func:`parse_reply_bodies`
+speculatively parses **every** layout at **every** frame — each parse is
+a handful of ~4-byte gathers, so the redundant work is noise — and the
+consumer selects the right view per frame using its host-side
+xid -> opcode map.  All reads are mask-clamped: invalid frames and
+out-of-extent offsets yield zeros, never out-of-bounds gathers.
+
+64-bit Stat fields (zxids, times, ephemeralOwner) are (hi, lo) int32
+pairs, per the convention in :mod:`bytesops`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .bytesops import be_i32_at, be_i64pair_at
+
+#: Reply header width: xid:int32 + zxid:int64 + err:int32
+#: (reference: lib/zk-buffer.js:281-284).
+REPLY_HDR = 16
+
+#: Serialized Stat width: 6 longs + 5 ints
+#: (reference: lib/zk-buffer.js:428-442).
+STAT_WIRE = 68
+
+#: (field name, byte offset within the Stat, is 64-bit) in wire order.
+_STAT_FIELDS = (
+    ('czxid', 0, True),
+    ('mzxid', 8, True),
+    ('ctime', 16, True),
+    ('mtime', 24, True),
+    ('version', 32, False),
+    ('cversion', 36, False),
+    ('aversion', 40, False),
+    ('ephemeralOwner', 44, True),
+    ('dataLength', 52, False),
+    ('numChildren', 56, False),
+    ('pzxid', 60, True),
+)
+
+
+class StatPlanes(NamedTuple):
+    """A batched Stat: one int32 [B, F] plane per 32-bit field, (hi, lo)
+    plane pairs per 64-bit field, plus the validity mask."""
+
+    czxid_hi: jnp.ndarray
+    czxid_lo: jnp.ndarray
+    mzxid_hi: jnp.ndarray
+    mzxid_lo: jnp.ndarray
+    ctime_hi: jnp.ndarray
+    ctime_lo: jnp.ndarray
+    mtime_hi: jnp.ndarray
+    mtime_lo: jnp.ndarray
+    version: jnp.ndarray
+    cversion: jnp.ndarray
+    aversion: jnp.ndarray
+    ephemeralOwner_hi: jnp.ndarray
+    ephemeralOwner_lo: jnp.ndarray
+    dataLength: jnp.ndarray
+    numChildren: jnp.ndarray
+    pzxid_hi: jnp.ndarray
+    pzxid_lo: jnp.ndarray
+    valid: jnp.ndarray
+
+
+def parse_stats(buf, off, valid) -> StatPlanes:
+    """Parse a Stat record at absolute byte offset ``off`` of each
+    stream.
+
+    Args:
+      buf: uint8 [B, L] stream bytes.
+      off: int32 [B, F] absolute offset of each frame's Stat.
+      valid: bool [B, F] which (stream, frame) slots hold a Stat whose
+        extent really lies within the frame; fields are 0 elsewhere.
+    """
+    off = jnp.where(valid, off, 0)
+    out = {}
+    for name, rel, is_long in _STAT_FIELDS:
+        if is_long:
+            hi, lo = be_i64pair_at(buf, off + rel)
+            out[name + '_hi'] = jnp.where(valid, hi, 0)
+            out[name + '_lo'] = jnp.where(valid, lo, 0)
+        else:
+            out[name] = jnp.where(valid, be_i32_at(buf, off + rel), 0)
+    return StatPlanes(valid=valid, **out)
+
+
+def slice_var_bytes(buf, off, lens, max_len: int):
+    """Gather a variable-width byte field (buffer payload or ustring
+    text) from each frame into a dense [B, F, max_len] tensor.
+
+    Args:
+      buf: uint8 [B, L] stream bytes.
+      off: int32 [B, F] absolute start of the field's bytes.
+      lens: int32 [B, F] field byte counts (callers pass the already
+        clamped-to->=0 jute length).
+      max_len: static output width; longer fields truncate (visible to
+        callers via ``lens``).
+
+    Returns:
+      (data, mask): uint8 [B, F, max_len] zero-padded and its validity
+      mask.
+    """
+    B, L = buf.shape
+    pos = jnp.arange(max_len, dtype=jnp.int32)
+    idx = off[..., None] + pos
+    mask = (pos < lens[..., None]) & (idx < L) & (off[..., None] >= 0)
+    data = jnp.take_along_axis(
+        buf[:, None, :], jnp.where(mask, idx, 0), axis=2)
+    return jnp.where(mask, data, 0).astype(jnp.uint8), mask
+
+
+def _ustring_at(buf, off, valid, frame_end, max_len: int):
+    """Parse a jute buffer/ustring (int32 length + bytes) at ``off``.
+    Negative length decodes as empty (reference:
+    lib/jute-buffer.js:99-100).  Returns (raw_len, bytes, mask, ok)
+    where ``ok`` means the field's extent fits inside the frame."""
+    off = jnp.where(valid, off, 0)
+    raw = jnp.where(valid, be_i32_at(buf, off), 0)
+    n = jnp.maximum(raw, 0)
+    ok = valid & (off + 4 + n <= frame_end)
+    n = jnp.where(ok, n, 0)
+    data, mask = slice_var_bytes(buf, off + 4, n, max_len)
+    return jnp.where(ok, raw, 0), data, mask, ok
+
+
+class ReplyBodies(NamedTuple):
+    """Speculative parse of every fixed-layout reply body at every
+    frame.  Select the view matching each frame's opcode:
+
+    - EXISTS / SET_DATA -> ``stat0``
+    - GET_DATA          -> ``data_len``/``data``/``data_mask`` +
+      ``stat_after_data`` (its ``valid`` also proves the buffer field
+      fit the frame)
+    - CREATE            -> ``str0_len``/``str0``/``str0_mask``
+    - NOTIFICATION      -> ``ntype``/``nstate`` +
+      ``npath_len``/``npath``/``npath_mask``
+    """
+
+    stat0: StatPlanes
+    data_len: jnp.ndarray
+    data: jnp.ndarray
+    data_mask: jnp.ndarray
+    stat_after_data: StatPlanes
+    str0_len: jnp.ndarray
+    str0: jnp.ndarray
+    str0_mask: jnp.ndarray
+    ntype: jnp.ndarray
+    nstate: jnp.ndarray
+    npath_len: jnp.ndarray
+    npath: jnp.ndarray
+    npath_mask: jnp.ndarray
+
+
+def parse_reply_bodies(buf, starts, sizes, max_data: int = 128,
+                       max_path: int = 128) -> ReplyBodies:
+    """Parse all fixed-layout reply-body interpretations of every frame.
+
+    Args:
+      buf: uint8 [B, L] stream bytes.
+      starts: int32 [B, F] frame body offsets (-1 = no frame), as
+        produced by the frame scans (the reply header sits at the body
+        start; opcode payloads begin 16 bytes in).
+      sizes: int32 [B, F] frame body lengths.
+      max_data: static width for the GET_DATA payload bytes.
+      max_path: static width for CREATE/NOTIFICATION path bytes.
+    """
+    frame_ok = (starts >= 0) & (sizes >= REPLY_HDR)
+    start = jnp.where(frame_ok, starts, 0)
+    end = start + jnp.where(frame_ok, sizes, 0)      # frame extent
+    p = start + REPLY_HDR                            # payload start
+
+    # EXISTS / SET_DATA: Stat at payload start.
+    stat0 = parse_stats(buf, p, frame_ok & (p + STAT_WIRE <= end))
+
+    # GET_DATA: buffer then Stat.
+    data_len, data, data_mask, data_ok = _ustring_at(
+        buf, p, frame_ok, end, max_data)
+    stat_off = p + 4 + jnp.maximum(data_len, 0)
+    stat_after_data = parse_stats(
+        buf, stat_off, data_ok & (stat_off + STAT_WIRE <= end))
+
+    # CREATE: ustring at payload start (shares the buffer layout).
+    str0_len, str0, str0_mask, _ = _ustring_at(
+        buf, p, frame_ok, end, max_path)
+
+    # NOTIFICATION: type:int32, state:int32, path ustring
+    # (reference: lib/zk-buffer.js:364-370).
+    n_ok = frame_ok & (p + 8 <= end)
+    np_ = jnp.where(n_ok, p, 0)
+    ntype = jnp.where(n_ok, be_i32_at(buf, np_), 0)
+    nstate = jnp.where(n_ok, be_i32_at(buf, np_ + 4), 0)
+    npath_len, npath, npath_mask, _ = _ustring_at(
+        buf, p + 8, n_ok, end, max_path)
+
+    return ReplyBodies(
+        stat0=stat0,
+        data_len=data_len, data=data, data_mask=data_mask,
+        stat_after_data=stat_after_data,
+        str0_len=str0_len, str0=str0, str0_mask=str0_mask,
+        ntype=ntype, nstate=nstate,
+        npath_len=npath_len, npath=npath, npath_mask=npath_mask,
+    )
+
+
+# -- host-side views (numpy in, dataclasses out) --
+
+def stat_from_planes(planes, b: int, f: int):
+    """Collapse one (stream, frame) slot of a :class:`StatPlanes` (as
+    host numpy arrays) into the scalar codec's ``Stat`` dataclass."""
+    from ..protocol.records import Stat
+    from .bytesops import i64pair_to_int
+
+    def i64(name):
+        return i64pair_to_int(getattr(planes, name + '_hi')[b, f],
+                              getattr(planes, name + '_lo')[b, f])
+
+    def i32(name):
+        return int(getattr(planes, name)[b, f])
+
+    return Stat(
+        czxid=i64('czxid'), mzxid=i64('mzxid'),
+        ctime=i64('ctime'), mtime=i64('mtime'),
+        version=i32('version'), cversion=i32('cversion'),
+        aversion=i32('aversion'),
+        ephemeralOwner=i64('ephemeralOwner'),
+        dataLength=i32('dataLength'), numChildren=i32('numChildren'),
+        pzxid=i64('pzxid'))
