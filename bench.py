@@ -150,7 +150,177 @@ def bench_tensor(buf, lens) -> float:
     return best
 
 
+CLIENTS = 32          # concurrent clients for the runtime bench
+GETS_PER_CLIENT = 60  # measured get ops per client
+
+
+def _percentiles(lat_ms):
+    lat_ms = sorted(lat_ms)
+
+    def pct(p):
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(p / 100.0 * len(lat_ms)))]
+    return pct(50), pct(99)
+
+
+async def _client_ops_run(mode: str) -> dict:
+    """One end-to-end runtime measurement: ops/sec and latency
+    percentiles for get/set/create plus a watch fan-out, with CLIENTS
+    concurrent clients against the in-process server.
+
+    Modes: ``python`` (pure-Python scalar codec, the reference-idiom
+    baseline), ``native`` (C++ frame scanner), ``ingest`` (batched
+    TPU decode via FleetIngest)."""
+    import asyncio
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.server import ZKServer
+
+    ingest = None
+    use_native = None
+    if mode == 'ingest':
+        from zkstream_tpu.io.ingest import FleetIngest
+        ingest = FleetIngest(body_mode='host', max_frames=16)
+    elif mode == 'native':
+        use_native = True
+    elif mode == 'python':
+        use_native = False
+
+    loop = asyncio.get_event_loop()
+    srv = await ZKServer().start()
+    clients = [Client(address='127.0.0.1', port=srv.port,
+                      session_timeout=30000, ingest=ingest,
+                      use_native_codec=use_native)
+               for _ in range(CLIENTS)]
+    for c in clients:
+        c.start()
+    await asyncio.gather(*[c.wait_connected(timeout=30)
+                           for c in clients])
+    out = {'mode': mode}
+    try:
+        await clients[0].create('/b', b'x' * 64)
+
+        # Warm the path before timing: connection steady state, and —
+        # for the ingest — the jit cache across the padded batch-size
+        # buckets the tick loop will hit.
+        for _ in range(5):
+            await asyncio.gather(*[c.get('/b') for c in clients])
+
+        async def timed(coro_fn, n):
+            lat = []
+            for _ in range(n):
+                t0 = loop.time()
+                await coro_fn()
+                lat.append((loop.time() - t0) * 1000.0)
+            return lat
+
+        async def measure(name, coro_of, n_per_client):
+            t0 = loop.time()
+            lats = await asyncio.gather(*[
+                timed(coro_of(c, i), n_per_client)
+                for i, c in enumerate(clients)])
+            dt = loop.time() - t0
+            flat = [x for l in lats for x in l]
+            p50, p99 = _percentiles(flat)
+            out[name] = {
+                'ops_per_sec': round(len(flat) / dt, 1),
+                'p50_ms': round(p50, 3), 'p99_ms': round(p99, 3)}
+
+        await measure('get', lambda c, i: lambda: c.get('/b'),
+                      GETS_PER_CLIENT)
+        await measure('set',
+                      lambda c, i: lambda: c.set('/b', b'y' * 64),
+                      GETS_PER_CLIENT // 2)
+        seqs = [0] * CLIENTS
+
+        def mk_create(c, i):
+            async def run():
+                seqs[i] += 1
+                await c.create('/c%d-%d' % (i, seqs[i]), b'')
+            return run
+        await measure('create', mk_create, GETS_PER_CLIENT // 4)
+
+        # watch fan-out: every client watches one node; one set fires
+        # CLIENTS notifications + re-arm reads through the stack.
+        # Arming a dataChanged watch on an existing node emits once
+        # immediately (the arming read) — wait those out and reset so
+        # the timed window measures only the real notifications.
+        fired = []
+        armed = loop.create_future()
+        done = loop.create_future()
+
+        def on_fire(*a):
+            fired.append(1)
+            if len(fired) >= CLIENTS:
+                if not armed.done():
+                    armed.set_result(None)
+                elif len(fired) >= CLIENTS and not done.done():
+                    done.set_result(None)
+        for c in clients:
+            c.watcher('/b').on('dataChanged', on_fire)
+        await asyncio.wait_for(armed, 10)   # all arm-time emits in
+        await asyncio.sleep(0.2)            # all watches re-armed
+        fired.clear()
+        t0 = loop.time()
+        await clients[0].set('/b', b'z' * 64)
+        await asyncio.wait_for(done, 10)
+        dt = loop.time() - t0
+        out['watch_fanout'] = {
+            'events': len(fired),
+            'events_per_sec': round(len(fired) / dt, 1),
+            'total_ms': round(dt * 1000.0, 2)}
+        if ingest is not None:
+            out['ingest_ticks'] = ingest.ticks
+            out['ingest_frames'] = ingest.frames_routed
+    finally:
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()
+    return out
+
+
+def bench_client_ops() -> None:
+    """End-to-end runtime numbers (VERDICT r1 items 1/8): the full
+    asyncio client stack against the in-process server, per codec
+    mode.  Secondary metrics: printed to stderr, one JSON line per
+    mode, after the flagship decode numbers are already measured (the
+    readbacks here would poison remote-TPU dispatch timing)."""
+    import asyncio
+
+    from zkstream_tpu.utils import native
+
+    modes = ['python']
+    if native.ensure_lib() is not None:
+        modes.append('native')
+    modes.append('ingest')
+    results = {}
+    for mode in modes:
+        results[mode] = asyncio.run(_client_ops_run(mode))
+        print('# client_ops %s' % json.dumps(results[mode]),
+              file=sys.stderr)
+    base = results['python']['get']['ops_per_sec']
+    best_mode = max(results, key=lambda m: results[m]['get']['ops_per_sec'])
+    print(json.dumps({
+        'metric': 'client_get_ops_per_sec',
+        'value': results[best_mode]['get']['ops_per_sec'],
+        'unit': 'ops/s',
+        'vs_baseline': round(
+            results[best_mode]['get']['ops_per_sec'] / base, 3),
+        'mode': best_mode,
+    }), file=sys.stderr)
+
+
 def main() -> None:
+    # Initialize the host CPU backend FIRST: the fleet ingest's
+    # latency-aware placement wants it, and creating a second PJRT
+    # client after heavy accelerator use has been observed to block on
+    # a tunneled TPU (the ingest guards with a timeout, but eager init
+    # here makes the fast path deterministic).
+    try:
+        import jax
+        jax.devices('cpu')
+    except Exception as e:  # pragma: no cover - environment-specific
+        print('# cpu backend unavailable: %s' % (e,), file=sys.stderr)
+
     buf, lens, streams = _fleet()
     scalar = bench_scalar(streams)
     tensor = bench_tensor(buf, lens)
@@ -162,6 +332,7 @@ def main() -> None:
     }))
     print(f'# scalar baseline: {scalar:.2f} MiB/s over {B} streams x '
           f'{FRAMES} frames', file=sys.stderr)
+    bench_client_ops()
 
 
 if __name__ == '__main__':

@@ -58,7 +58,9 @@ class Client(FSM):
                  decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
                  shuffle_backends: bool = True,
                  seed: int | None = None,
-                 log: Logger | None = None):
+                 log: Logger | None = None,
+                 ingest=None,
+                 use_native_codec: bool | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -79,6 +81,16 @@ class Client(FSM):
         # lib/client.js:34-45); components derive context-accreting
         # children from it.
         self.log = Logger(log).child(component='ZKClient')
+
+        #: Optional shared FleetIngest (io/ingest.py): when set, this
+        #: client's connections drain through the batched TPU decode
+        #: pipeline instead of per-socket scalar codecs.  Many clients
+        #: may share one ingest — that is the point.
+        self.ingest = ingest
+        #: Frame-scanner selection for this client's connections:
+        #: None = auto (native if built), True = force C++, False =
+        #: force pure Python (benchmarks, A/B tests).
+        self.use_native_codec = use_native_codec
 
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,

@@ -97,6 +97,10 @@ class ZKConnection(FSM):
         self.codec: PacketCodec | None = None
         self.transport = None
         self.session = None
+        #: Optional FleetIngest: when the owning client carries one,
+        #: connected-state bytes drain through the batched device
+        #: pipeline instead of the per-socket scalar codec.
+        self.ingest = getattr(client, 'ingest', None)
         self.last_error: Exception | None = None
         self._xid = 0
         #: xid -> ZKRequest for everything awaiting a reply
@@ -131,7 +135,8 @@ class ZKConnection(FSM):
         S.on(self, 'connectAsserted', lambda: S.goto_state('connecting'))
 
     def state_connecting(self, S) -> None:
-        self.codec = PacketCodec()
+        self.codec = PacketCodec(
+            use_native=getattr(self.client, 'use_native_codec', None))
         self.log.debug('attempting new connection')
 
         async def dial():
@@ -231,14 +236,7 @@ class ZKConnection(FSM):
         ping_interval = max(self.session.get_timeout() / 4, 2000)
         S.interval(ping_interval, self.ping)
 
-        def on_data(data):
-            err = None
-            try:
-                pkts = self.codec.decode(data)
-            except ZKProtocolError as e:
-                # Deliver packets decoded before the bad frame first.
-                pkts = getattr(e, 'packets', [])
-                err = e
+        def deliver(pkts, err):
             for pkt in pkts:
                 self.emit('packet', pkt)
                 # Notifications are the session's business
@@ -248,7 +246,28 @@ class ZKConnection(FSM):
             if err is not None:
                 self.last_error = err
                 S.goto_state('error')
-        S.on(self, 'sockData', on_data)
+
+        if self.ingest is not None:
+            # Fleet drain: bytes go to the batched device pipeline; the
+            # ingest routes the decoded packets back through the same
+            # deliver path, so semantics cannot diverge from the scalar
+            # drain below.
+            self.ingest.register(self)
+            S.defer(lambda: self.ingest.unregister(self))
+            S.on(self, 'sockData',
+                 lambda data: self.ingest.feed(self, data))
+            S.on(self, 'ingestDeliver', deliver)
+        else:
+            def on_data(data):
+                err = None
+                try:
+                    pkts = self.codec.decode(data)
+                except ZKProtocolError as e:
+                    # Deliver packets decoded before the bad frame first.
+                    pkts = getattr(e, 'packets', [])
+                    err = e
+                deliver(pkts, err)
+            S.on(self, 'sockData', on_data)
 
         def on_error(err):
             self.last_error = err

@@ -36,6 +36,8 @@ class WireStats(NamedTuple):
     sizes: jnp.ndarray         # int32 [B, F] frame body lengths
     xids: jnp.ndarray          # int32 [B, F] reply xids (0 where pad)
     errs: jnp.ndarray          # int32 [B, F] reply error codes
+    zxid_hi: jnp.ndarray       # int32 [B, F] per-reply zxid, high word
+    zxid_lo: jnp.ndarray       # int32 [B, F] per-reply zxid, low word
     n_frames: jnp.ndarray      # int32 [B]
     n_replies: jnp.ndarray     # int32 [B]
     n_notifications: jnp.ndarray  # int32 [B]
@@ -58,6 +60,8 @@ def _assemble(headers, starts, sizes, counts, bad, resid) -> WireStats:
         sizes=sizes,
         xids=headers['xid'],
         errs=headers['err'],
+        zxid_hi=headers['zxid_hi'],
+        zxid_lo=headers['zxid_lo'],
         n_frames=counts,
         n_replies=stats['n_replies'],
         n_notifications=stats['n_notifications'],

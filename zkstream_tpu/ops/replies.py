@@ -35,11 +35,8 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from ..protocol.consts import REPLY_HDR
 from .bytesops import be_i32_at, be_i64pair_at
-
-#: Reply header width: xid:int32 + zxid:int64 + err:int32
-#: (reference: lib/zk-buffer.js:281-284).
-REPLY_HDR = 16
 
 #: Serialized Stat width: 6 longs + 5 ints
 #: (reference: lib/zk-buffer.js:428-442).
@@ -163,15 +160,18 @@ class ReplyBodies(NamedTuple):
     data_len: jnp.ndarray
     data: jnp.ndarray
     data_mask: jnp.ndarray
+    data_ok: jnp.ndarray       # buffer field extent fit the frame
     stat_after_data: StatPlanes
     str0_len: jnp.ndarray
     str0: jnp.ndarray
     str0_mask: jnp.ndarray
+    str0_ok: jnp.ndarray       # ustring extent fit the frame
     ntype: jnp.ndarray
     nstate: jnp.ndarray
     npath_len: jnp.ndarray
     npath: jnp.ndarray
     npath_mask: jnp.ndarray
+    npath_ok: jnp.ndarray      # notification path extent fit the frame
 
 
 def parse_reply_bodies(buf, starts, sizes, max_data: int = 128,
@@ -203,7 +203,7 @@ def parse_reply_bodies(buf, starts, sizes, max_data: int = 128,
         buf, stat_off, data_ok & (stat_off + STAT_WIRE <= end))
 
     # CREATE: ustring at payload start (shares the buffer layout).
-    str0_len, str0, str0_mask, _ = _ustring_at(
+    str0_len, str0, str0_mask, str0_ok = _ustring_at(
         buf, p, frame_ok, end, max_path)
 
     # NOTIFICATION: type:int32, state:int32, path ustring
@@ -212,16 +212,19 @@ def parse_reply_bodies(buf, starts, sizes, max_data: int = 128,
     np_ = jnp.where(n_ok, p, 0)
     ntype = jnp.where(n_ok, be_i32_at(buf, np_), 0)
     nstate = jnp.where(n_ok, be_i32_at(buf, np_ + 4), 0)
-    npath_len, npath, npath_mask, _ = _ustring_at(
+    npath_len, npath, npath_mask, npath_ok = _ustring_at(
         buf, p + 8, n_ok, end, max_path)
 
     return ReplyBodies(
         stat0=stat0,
         data_len=data_len, data=data, data_mask=data_mask,
+        data_ok=data_ok,
         stat_after_data=stat_after_data,
         str0_len=str0_len, str0=str0, str0_mask=str0_mask,
+        str0_ok=str0_ok,
         ntype=ntype, nstate=nstate,
         npath_len=npath_len, npath=npath, npath_mask=npath_mask,
+        npath_ok=npath_ok,
     )
 
 

@@ -86,6 +86,7 @@ def sharded_wire_step(mesh: Mesh, max_frames: int = 32):
 _WIRE_STATS_DP_SPEC = WireStats(
     starts=P('dp', None), sizes=P('dp', None),
     xids=P('dp', None), errs=P('dp', None),
+    zxid_hi=P('dp', None), zxid_lo=P('dp', None),
     n_frames=P('dp'), n_replies=P('dp'),
     n_notifications=P('dp'), n_pings=P('dp'),
     n_errors=P('dp'), max_zxid_hi=P('dp'),

@@ -160,6 +160,10 @@ PROTOCOL_VERSION = 0
 #: (reference: lib/zk-streams.js:23).
 MAX_PACKET = 16 * 1024 * 1024
 
+#: Reply header width: xid:int32 + zxid:int64 + err:int32
+#: (reference: lib/zk-buffer.js:281-284).
+REPLY_HDR = 16
+
 
 def err_name(code: int) -> str:
     """Map a numeric error code to its name; unknown codes become

@@ -100,6 +100,18 @@ class FrameDecoder:
         """Bytes buffered but not yet sliced into a frame."""
         return len(self._buf)
 
+    def take_pending(self) -> bytes:
+        """Hand off the undecoded residue (a partial frame) and clear
+        it — used when an external drain (the fleet ingest) takes over
+        this stream mid-flight."""
+        out = bytes(self._buf)
+        self._buf.clear()
+        return out
+
+    def restore_pending(self, data: bytes) -> None:
+        """Give residue back (the external drain returned the stream)."""
+        self._buf[:0] = data
+
 
 def frame(body: bytes) -> bytes:
     """Wrap an encoded message body in its length prefix."""
@@ -114,8 +126,9 @@ class PacketCodec:
     request/reply formats (reference: lib/zk-streams.js:68,126).
     """
 
-    def __init__(self, server: bool = False):
-        self._decoder = FrameDecoder()
+    def __init__(self, server: bool = False,
+                 use_native: bool | None = None):
+        self._decoder = FrameDecoder(use_native=use_native)
         self._server = server
         self.handshaking = True
         #: xid -> opcode for replies in flight
@@ -136,6 +149,14 @@ class PacketCodec:
             records.write_request(w, pkt)
             self.xid_map[pkt['xid']] = pkt['opcode']
         return frame(w.to_bytes())
+
+    def take_pending(self) -> bytes:
+        """See :meth:`FrameDecoder.take_pending`."""
+        return self._decoder.take_pending()
+
+    def restore_pending(self, data: bytes) -> None:
+        """See :meth:`FrameDecoder.restore_pending`."""
+        self._decoder.restore_pending(data)
 
     def decode(self, chunk: bytes) -> list[dict]:
         """Absorb incoming bytes; return the packets completed by them.

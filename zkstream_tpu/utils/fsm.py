@@ -71,6 +71,11 @@ class StateScope:
         handle = loop.call_soon(lambda: self._valid and cb())
         self._disposers.append(handle.cancel)
 
+    def defer(self, cb: Callable[[], None]) -> None:
+        """Run ``cb`` when the machine leaves this state (scope-exit
+        cleanup, e.g. deregistering from an external registry)."""
+        self._disposers.append(cb)
+
     def goto_state(self, name: str) -> None:
         if self._valid:
             self._fsm._transition(name)
