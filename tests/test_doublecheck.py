@@ -58,33 +58,54 @@ async def test_doublecheck_probe_clean(fast_doublecheck, client):
 
 async def test_doublecheck_detects_missed_wakeup(
         event_loop, fast_doublecheck, client):
-    """If the zxid moved behind the watch's back, the probe must raise
-    LostWakeupError (crash-on-bug, reference: lib/zk-session.js:916-919).
-    The error surfaces through the transport's protocol callback, so it
-    lands in the loop exception handler."""
+    """If the zxid moved behind the watch's back, the probe escalates
+    fatally BY DEFAULT — no custom handler installed: the client emits
+    'failed' with the LostWakeupError, the session tears down through
+    'expire', and the loop's exception handler is invoked (crash-on-bug,
+    reference: lib/zk-session.js:916-919)."""
     await client.create('/dc2', b'v0')
     seen = []
     client.watcher('/dc2').on('dataChanged',
                               lambda data, stat: seen.append(bytes(data)))
     await wait_until(lambda: seen == [b'v0'])
 
+    failures, expires = [], []
+    client.on('failed', lambda *a: failures.append(a))
+    client.on('expire', lambda *a: expires.append(True))
+    sess = client.session
+
     we = client.watcher('/dc2').watch_events['dataChanged']
     # Simulate a lost wakeup: the node's mzxid no longer matches what
     # the armed watch believes it last emitted for.
     we.prev_zxid -= 1
 
-    crashes = []
+    # Process-visible failure, with NO handler installed anywhere.
+    await wait_until(lambda: failures and expires, timeout=10)
+    assert isinstance(failures[0][0], LostWakeupError)
+    assert sess.is_in_state('expired')
 
-    def on_exc(loop, context):
-        exc = context.get('exception')
-        if isinstance(exc, LostWakeupError):
-            crashes.append(exc)
-    event_loop.set_exception_handler(on_exc)
+
+async def test_missed_wakeup_custom_fatal_handler(
+        fast_doublecheck, server):
+    """on_fatal= overrides the loud default; teardown still happens."""
+    caught = []
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, on_fatal=caught.append)
+    c.start()
+    await c.wait_connected(timeout=5)
     try:
-        await wait_until(lambda: bool(crashes), timeout=10)
+        await c.create('/dc4', b'v0')
+        seen = []
+        c.watcher('/dc4').on('dataChanged',
+                             lambda data, stat: seen.append(bytes(data)))
+        await wait_until(lambda: seen == [b'v0'])
+        sess = c.session
+        c.watcher('/dc4').watch_events['dataChanged'].prev_zxid -= 1
+        await wait_until(lambda: bool(caught), timeout=10)
+        assert isinstance(caught[0], LostWakeupError)
+        assert sess.is_in_state('expired')
     finally:
-        event_loop.set_exception_handler(None)
-    assert isinstance(crashes[0], LostWakeupError)
+        await c.close()
 
 
 async def test_doublecheck_defers_when_disconnected(monkeypatch, server):
@@ -130,15 +151,21 @@ async def test_doublecheck_defers_when_disconnected(monkeypatch, server):
         await c.close()
 
 
-async def test_notify_unmatched_raises(client):
+async def test_notify_unmatched_escalates_fatally(client):
     """A notification that matches no armed event FSM means our model of
-    ZK watch semantics is wrong: ZKWatcher.notify throws
-    (reference: lib/zk-session.js:584-592)."""
+    ZK watch semantics is wrong: crash-on-bug escalation — client emits
+    'failed' and the session tears down, with no handler installed
+    (reference throws: lib/zk-session.js:584-592)."""
     await client.create('/nm', b'')
     w = client.watcher('/nm')
     w.on('childrenChanged', lambda *a: None)
     await asyncio.sleep(0.1)
+    failures = []
+    client.on('failed', lambda *a: failures.append(a))
+    sess = client.session
     # 'created' fans out to createdOrDeleted/dataChanged only — neither
     # is armed here.
-    with pytest.raises(LostWakeupError):
-        w.notify('created')
+    w.notify('created')
+    await wait_until(lambda: bool(failures), timeout=5)
+    assert isinstance(failures[0][0], LostWakeupError)
+    assert sess.is_in_state('expired')

@@ -60,7 +60,9 @@ class Client(FSM):
                  seed: int | None = None,
                  log: Logger | None = None,
                  ingest=None,
-                 use_native_codec: bool | None = None):
+                 use_native_codec: bool | None = None,
+                 on_fatal=None,
+                 max_spares: int = 2):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -91,6 +93,10 @@ class Client(FSM):
         #: None = auto (native if built), True = force C++, False =
         #: force pure Python (benchmarks, A/B tests).
         self.use_native_codec = use_native_codec
+        #: Optional crash-on-bug policy override: called with the
+        #: exception after session teardown instead of the loud default
+        #: (loop exception handler).  See ZKSession.fatal_error.
+        self.on_fatal = on_fatal
 
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
@@ -105,7 +111,8 @@ class Client(FSM):
             connect_policy=connect_policy,
             default_policy=default_policy,
             decoherence_interval=decoherence_interval,
-            shuffle=shuffle_backends, seed=seed)
+            shuffle=shuffle_backends, seed=seed,
+            max_spares=max_spares)
         self.pool.on('stateChanged', self._on_pool_state_changed)
 
         self._started = False
@@ -167,7 +174,18 @@ class Client(FSM):
         if not self.is_in_state('normal'):
             return
         s = ZKSession(self.session_timeout, self.collector, log=self.log)
+        s.fatal_handler = self.on_fatal
         self.session = s
+
+        def on_fatal(exc):
+            # Crash-on-bug escalation from the session's self-checks
+            # (missed wakeup, unmatched notification): surface as the
+            # terminal 'failed' event; the session teardown follows as
+            # 'expire' (reference crashes the process outright,
+            # lib/zk-session.js:916-919).
+            self._event_track('failed')
+            self.emit('failed', exc)
+        s.on('fatalError', on_fatal)
 
         def initial_handler(st):
             if st == 'attached':

@@ -83,7 +83,14 @@ class _SocketProtocol(asyncio.Protocol):
 
 
 class ZKConnection(FSM):
-    def __init__(self, client, backend: Backend):
+    def __init__(self, client, backend: Backend, spare: bool = False):
+        #: A spare parks after the TCP connect instead of handshaking:
+        #: the ZK handshake binds the session to one connection, so a
+        #: warm standby must stop just short of it.  ``promote()``
+        #: resumes the normal lifecycle (pool failover skips the TCP
+        #: dial; cueball's target 1 / max 3 warm set,
+        #: reference: lib/client.js:108-109).
+        self.spare = spare
         #: The owning client; consulted for the session during handshake
         #: (reference: lib/connection-fsm.js:174).
         self.client = client
@@ -125,6 +132,13 @@ class ZKConnection(FSM):
             return
         self.emit('destroyAsserted')
 
+    def promote(self) -> None:
+        """Turn a parked spare into a live connection: run the ZK
+        handshake on the already-open socket."""
+        assert self.is_in_state('parked'), self.get_state()
+        self.spare = False
+        self.emit('promoteAsserted')
+
     def next_xid(self) -> int:
         self._xid += 1
         return self._xid
@@ -152,13 +166,42 @@ class ZKConnection(FSM):
 
         self._dial_task = asyncio.get_event_loop().create_task(dial())
 
-        S.on(self, 'sockConnect', lambda: S.goto_state('handshaking'))
+        S.on(self, 'sockConnect', lambda: S.goto_state(
+            'parked' if self.spare else 'handshaking'))
 
         def on_error(err):
             self.last_error = err
             S.goto_state('error')
         S.on(self, 'sockError', on_error)
         S.on(self, 'sockClose', lambda: S.goto_state('closed'))
+        S.on(self, 'closeAsserted', lambda: S.goto_state('closed'))
+        S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
+
+    def state_parked(self, S) -> None:
+        """Warm spare: TCP is open, no ZK bytes exchanged.  Wakes into
+        ``handshaking`` on promote; any socket activity or death tears
+        it down (a ZK server must not speak first, so inbound data here
+        is a protocol violation)."""
+        S.on(self, 'promoteAsserted',
+             lambda: S.goto_state('handshaking'))
+
+        def on_data(_data):
+            self.last_error = ZKProtocolError('UNEXPECTED_PACKET',
+                'Server sent data before the handshake')
+            S.goto_state('error')
+        S.on(self, 'sockData', on_data)
+
+        def on_error(err):
+            self.last_error = err
+            S.goto_state('error')
+        S.on(self, 'sockError', on_error)
+
+        def on_end():
+            self.last_error = ZKProtocolError('CONNECTION_LOSS',
+                'Connection closed unexpectedly.')
+            S.goto_state('error')
+        S.on(self, 'sockEnd', on_end)
+        S.on(self, 'sockClose', on_end)
         S.on(self, 'closeAsserted', lambda: S.goto_state('closed'))
         S.on(self, 'destroyAsserted', lambda: S.goto_state('closed'))
 

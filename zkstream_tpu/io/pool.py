@@ -54,7 +54,8 @@ class ConnectionPool(EventEmitter):
                  connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
                  default_policy: RecoveryPolicy = DEFAULT_POLICY,
                  decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
-                 shuffle: bool = True, seed: int | None = None):
+                 shuffle: bool = True, seed: int | None = None,
+                 max_spares: int = 2):
         super().__init__()
         assert backends, 'at least one backend required'
         self._client = client
@@ -83,6 +84,14 @@ class ConnectionPool(EventEmitter):
         self._stopping = False
         self._failed_emitted = False
 
+        #: Warm spares: TCP-connected, pre-handshake standbys promoted
+        #: on failover instead of paying a fresh dial (cueball keeps up
+        #: to 3 connections, target 1 — reference: lib/client.js:108-109).
+        self.max_spares = max_spares
+        self.spares: list[ZKConnection] = []
+        self._spare_task: asyncio.Task | None = None
+        self._spare_wake: asyncio.Event | None = None
+
     @property
     def backends(self) -> list[Backend]:
         return list(self._backends)
@@ -96,13 +105,23 @@ class ConnectionPool(EventEmitter):
         assert self._task is None, 'pool already started'
         self._stopping = False
         self._set_state('starting')
-        self._task = asyncio.get_event_loop().create_task(self._dial_loop())
+        loop = asyncio.get_event_loop()
+        self._task = loop.create_task(self._dial_loop())
+        if self.max_spares > 0:
+            self._spare_wake = asyncio.Event()
+            self._spare_task = loop.create_task(self._spare_loop())
 
     def stop(self) -> None:
         self._stopping = True
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._spare_task is not None:
+            self._spare_task.cancel()
+            self._spare_task = None
+        spares, self.spares = self.spares, []
+        for s in spares:
+            s.destroy()
         self._cancel_decoherence()
         if self._decoherence_task is not None:
             self._decoherence_task.cancel()
@@ -121,6 +140,7 @@ class ConnectionPool(EventEmitter):
         self.conn = conn
         self._conn_index = idx
         self.emit('added', conn.backend.key, conn)
+        self._wake_spares()
 
         def on_dead(*args):
             # Only react if this is still the pool's current connection
@@ -152,21 +172,25 @@ class ConnectionPool(EventEmitter):
 
     # -- dialing --
 
-    async def _dial_one(self, backend: Backend,
-                        timeout_ms: int) -> ZKConnection | None:
-        """Dial one backend; resolve to the connection if it reaches
-        'connected' within the timeout, else None."""
-        conn = ZKConnection(self._client, backend)
+    async def _await_conn(self, conn: ZKConnection, want_state: str,
+                          timeout_ms: int) -> ZKConnection | None:
+        """Wait until ``conn`` reaches ``want_state`` or dies (timeout
+        included); returns the connection on success, else destroys it
+        and returns None.  Shared by dialing, spare parking, and spare
+        promotion so the wait/cleanup/cancel handling cannot diverge."""
         loop = asyncio.get_event_loop()
         fut: asyncio.Future = loop.create_future()
 
         def settle(*args):
             if not fut.done():
                 fut.set_result(None)
-        conn.on('connect', settle)
+
+        def on_state(st):
+            if st == want_state:
+                settle()
+        conn.on('stateChanged', on_state)
         conn.on('error', settle)
         conn.on('close', settle)
-        conn.connect()
         try:
             await asyncio.wait_for(asyncio.shield(fut),
                                    timeout_ms / 1000.0)
@@ -176,20 +200,37 @@ class ConnectionPool(EventEmitter):
             conn.destroy()
             raise
         finally:
-            conn.remove_listener('connect', settle)
+            conn.remove_listener('stateChanged', on_state)
             conn.remove_listener('error', settle)
             conn.remove_listener('close', settle)
-        if conn.is_in_state('connected'):
+        if conn.is_in_state(want_state):
             return conn
         conn.destroy()
         return None
 
+    async def _dial_one(self, backend: Backend,
+                        timeout_ms: int) -> ZKConnection | None:
+        """Dial one backend; resolve to the connection if it reaches
+        'connected' within the timeout, else None."""
+        conn = ZKConnection(self._client, backend)
+        conn.connect()
+        return await self._await_conn(conn, 'connected', timeout_ms)
+
     async def _dial_loop(self) -> None:
         """Keep one live connection.  The initial phase uses the connect
         policy; once it exhausts on all backends, emit 'failed' and keep
-        dialing under the default policy (cueball monitor mode)."""
+        dialing under the default policy (cueball monitor mode).
+        Failover promotes a warm spare when one is parked — no fresh
+        TCP dial."""
         policy = self._connect_policy
         while not self._stopping:
+            promoted = await self._promote_spare()
+            if promoted is not None:
+                idx, conn = promoted
+                self._failed_emitted = False
+                await self._hold_connection(idx, conn)
+                policy = self._connect_policy
+                continue
             connected = False
             for attempt in range(policy.retries):
                 for idx, backend in enumerate(self._backends):
@@ -233,6 +274,103 @@ class ConnectionPool(EventEmitter):
         finally:
             self._hold = None
             self._cancel_decoherence()
+
+    # -- warm spares (cueball target 1 / max 3) --
+
+    def _wake_spares(self) -> None:
+        if self._spare_wake is not None:
+            self._spare_wake.set()
+
+    def _backend_index(self, backend: Backend) -> int:
+        for i, b in enumerate(self._backends):
+            if b.key == backend.key:
+                return i
+        return len(self._backends) - 1
+
+    async def _spare_loop(self) -> None:
+        """Keep up to ``max_spares`` parked standbys while a live
+        connection exists.  Dial failures retry on the default policy's
+        delay; an unfillable deficit (no candidate backends, e.g. a
+        single-address client already holding its one spare) parks on
+        the wake event instead of polling."""
+        while not self._stopping:
+            await self._spare_wake.wait()
+            self._spare_wake.clear()
+            while (not self._stopping and self.conn is not None
+                   and len(self.spares) < self.max_spares):
+                outcome = await self._add_one_spare()
+                if outcome is True:
+                    continue
+                if outcome is None:
+                    break  # no candidates: wait for a wake, not a timer
+                try:
+                    await asyncio.wait_for(
+                        self._spare_wake.wait(),
+                        self._default_policy.delay / 1000.0)
+                except asyncio.TimeoutError:
+                    pass
+                self._spare_wake.clear()
+
+    async def _add_one_spare(self) -> bool | None:
+        """True = spare added; False = candidates exist but none
+        reachable (caller retries on a delay); None = no candidate
+        backends at all (caller waits for a wake)."""
+        cur = self.conn.backend.key if self.conn is not None else None
+        have = {s.backend.key for s in self.spares}
+        cands = [b for b in self._backends
+                 if b.key != cur and b.key not in have]
+        if not cands and len(self._backends) == 1 and not self.spares:
+            # single-backend config: a same-backend spare still skips
+            # the TCP dial on failover
+            cands = [self._backends[0]]
+        if not cands:
+            return None
+        for backend in cands:
+            conn = await self._dial_spare(backend)
+            if self._stopping or self.conn is None:
+                if conn is not None:
+                    conn.destroy()
+                return False
+            if conn is not None:
+                self._install_spare(conn)
+                return True
+        return False
+
+    async def _dial_spare(self, backend: Backend) -> ZKConnection | None:
+        """TCP-connect a spare; resolve once it parks (or dies)."""
+        conn = ZKConnection(self._client, backend, spare=True)
+        conn.connect()
+        return await self._await_conn(conn, 'parked',
+                                      self._connect_policy.timeout)
+
+    def _install_spare(self, conn: ZKConnection) -> None:
+        self.spares.append(conn)
+        self.log.debug('warm spare parked for %s', conn.backend.key)
+
+        def on_dead(*args):
+            if conn in self.spares:
+                self.spares.remove(conn)
+                self._wake_spares()
+        conn.on('error', on_dead)
+        conn.on('close', on_dead)
+
+    async def _promote_spare(self) -> tuple[int, ZKConnection] | None:
+        """Promote the most-preferred parked spare into a live
+        connection (handshake only — the TCP dial already happened)."""
+        while self.spares and not self._stopping:
+            conn = min(self.spares,
+                       key=lambda s: self._backend_index(s.backend))
+            self.spares.remove(conn)
+            if not conn.is_in_state('parked'):
+                conn.destroy()
+                continue
+            self.log.info('promoting warm spare to %s', conn.backend.key)
+            conn.promote()
+            if await self._await_conn(conn, 'connected',
+                                      self._connect_policy.timeout):
+                self._wake_spares()
+                return self._backend_index(conn.backend), conn
+        return None
 
     # -- decoherence: move toward preferred backends --
 

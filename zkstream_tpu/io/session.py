@@ -63,6 +63,11 @@ class ZKSession(FSM):
         self.session_id = 0
         self.passwd = b'\x00' * 16
 
+        #: Optional override for crash-on-bug escalation (see
+        #: :meth:`fatal_error`); None = loud default (loop exception
+        #: handler after teardown).
+        self.fatal_handler = None
+
         super().__init__('detached')
 
     # -- public accessors --
@@ -116,6 +121,31 @@ class ZKSession(FSM):
 
     def close(self) -> None:
         self.emit('closeAsserted')
+
+    def fatal_error(self, exc: BaseException) -> None:
+        """Crash-on-bug escalation for self-check failures (missed
+        wakeups, unmatchable notifications).  The reference throws to
+        kill the process (lib/zk-session.js:916-919); here the loud
+        default is: log critical, tear the session down through the
+        terminal ``expired`` path (connection destroyed, ``expire``/
+        ``failed`` surfaced to the client), and hand the exception to
+        the event loop's exception handler so an unconfigured process
+        prints a traceback.  Installing a ``fatalError`` listener makes
+        the policy configurable — teardown still happens, but the loop
+        handler is not invoked."""
+        self.log.fatal('fatal self-check failure: %s', exc)
+        self.emit('fatalError', exc)
+        if not (self.is_in_state('expired') or
+                self.is_in_state('closed')):
+            self._transition('expired')
+        if self.fatal_handler is not None:
+            self.fatal_handler(exc)
+        else:
+            asyncio.get_event_loop().call_exception_handler({
+                'message': 'zkstream fatal self-check failure '
+                           '(crash-on-bug)',
+                'exception': exc,
+            })
 
     # -- states --
 

@@ -92,8 +92,13 @@ class ZKWatcher(EventEmitter):
                 event.notify()
                 notified = True
         if not notified:
-            raise LostWakeupError('Got notification for %s but have no '
-                'matching events on %s' % (evt, self.path))
+            # Crash-on-bug: escalate through the session's fatal path
+            # (teardown + 'failed'/'expire' + loop exception handler by
+            # default) so the failure is loud even with no handler
+            # installed (reference throws: lib/zk-session.js:584-592).
+            self.session.fatal_error(LostWakeupError(
+                'Got notification for %s but have no matching events '
+                'on %s' % (evt, self.path)))
 
     def on(self, evt: str, cb) -> 'ZKWatcher':
         first = self.listener_count(evt) < 1
@@ -264,8 +269,13 @@ class ZKWatchEvent(FSM):
             else:
                 raise ValueError('Unknown watcher event %s' % (self.evt,))
             if self.prev_zxid is None or zxid != self.prev_zxid:
-                raise LostWakeupError('ZKWatchEvent double-check failed: '
-                    'a ZK event wakeup was missed, this is a bug')
+                # Crash-on-bug (see ZKWatcher.notify): fatal by
+                # default, never a swallowed callback exception
+                # (reference throws: lib/zk-session.js:916-919).
+                self.session.fatal_error(LostWakeupError(
+                    'ZKWatchEvent double-check failed: a ZK event '
+                    'wakeup was missed, this is a bug'))
+                return
             S.goto_state('armed')
         S.on(req, 'reply', on_reply)
         S.on(req, 'error', lambda err, *a: S.goto_state('armed'))

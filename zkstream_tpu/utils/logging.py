@@ -80,3 +80,8 @@ class Logger:
 
     def error(self, msg: str, *args) -> None:
         self._log(_logging.ERROR, msg, *args)
+
+    def fatal(self, msg: str, *args) -> None:
+        """Bunyan's top level (the reference logs at fatal before
+        crash-on-bug throws)."""
+        self._log(_logging.CRITICAL, msg, *args)
