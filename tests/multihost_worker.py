@@ -1,0 +1,95 @@
+"""Worker process for the two-process multihost test (run via
+subprocess by tests/test_multihost.py; not collected by pytest).
+
+Each process plays one "host" of a 2-host cluster with 4 virtual CPU
+devices: joins jax.distributed, assembles its OWN connection streams
+into the dp-sharded global batch with host_local_wire_batch (no
+cross-host data movement), runs sharded_wire_step, and checks the
+DCN-reduced global stats against the deterministic expected totals.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+
+
+def build_local_batch(proc_id: int, rows: int, frames: int, length: int):
+    import numpy as np
+
+    buf = np.zeros((rows, length), np.uint8)
+    lens = np.zeros((rows,), np.int32)
+    max_zxid = 0
+    for r in range(rows):
+        s = b''
+        for f in range(frames):
+            xid = 1 + r * frames + f
+            # distinct zxids per host so the global max is known
+            zxid = (proc_id + 1) * 100000 + r * frames + f
+            max_zxid = max(max_zxid, zxid)
+            body = struct.pack('>iqi', xid, zxid, 0) + b'\xab' * 8
+            s += struct.pack('>i', len(body)) + body
+        buf[r, :len(s)] = np.frombuffer(s, np.uint8)
+        lens[r] = len(s)
+    return buf, lens, max_zxid
+
+
+def main() -> int:
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    coord = sys.argv[3]
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        '--xla_force_host_platform_device_count=4 '
+        + os.environ.get('XLA_FLAGS', ''))
+
+    import jax
+
+    from zkstream_tpu.parallel import make_mesh, sharded_wire_step
+    from zkstream_tpu.parallel.multihost import (
+        host_local_wire_batch,
+        initialize,
+    )
+
+    initialize(coordinator_address=coord, num_processes=num_procs,
+               process_id=proc_id)
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert len(jax.devices()) == 4 * num_procs, jax.devices()
+
+    ROWS, FRAMES, L = 8, 6, 512
+    buf, lens, _ = build_local_batch(proc_id, ROWS, FRAMES, L)
+    mesh = make_mesh(sp=1)  # dp over all global devices
+
+    gbuf, glens = host_local_wire_batch(mesh, buf, lens)
+    assert gbuf.shape == (ROWS * num_procs, L), gbuf.shape
+
+    step = sharded_wire_step(mesh, max_frames=FRAMES)
+    stats, g = step(gbuf, glens)
+
+    # DCN-reduced scalars are replicated: every process can read them.
+    total = int(g.total_frames)
+    assert total == ROWS * FRAMES * num_procs, total
+    assert int(g.total_errors) == 0
+    # global max zxid = the largest any host generated (host num_procs-1)
+    _b, _l, last_host_max = build_local_batch(
+        num_procs - 1, ROWS, FRAMES, L)
+    got_max = (int(g.max_zxid_hi) << 32) | (int(g.max_zxid_lo) &
+                                            0xFFFFFFFF)
+    assert got_max == last_host_max, (got_max, last_host_max)
+
+    # Per-stream outputs stay dp-sharded; this host can read back the
+    # shards that live on its own devices and check its own rows.
+    local_frames = 0
+    for shard in stats.n_frames.addressable_shards:
+        local_frames += int(shard.data.sum())
+    assert local_frames == ROWS * FRAMES, local_frames
+
+    print('WORKER_OK %d total=%d max_zxid=%d' %
+          (proc_id, total, got_max), flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
