@@ -40,10 +40,15 @@ def main() -> int:
     num_procs = int(sys.argv[2])
     coord = sys.argv[3]
 
-    os.environ['JAX_PLATFORMS'] = 'cpu'
-    os.environ['XLA_FLAGS'] = (
-        '--xla_force_host_platform_device_count=4 '
-        + os.environ.get('XLA_FLAGS', ''))
+    # The image's site hook imports jax at interpreter startup to
+    # register the remote-TPU plugin, so env vars alone are read too
+    # late — force_cpu re-points the already-imported jax at 4 virtual
+    # CPU devices (must run before distributed init / first backend use).
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from zkstream_tpu.utils.platform import force_cpu
+
+    force_cpu(n_devices=4)
 
     import jax
 

@@ -27,7 +27,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(180)
 def test_two_process_sharded_wire_step():
     coord = '127.0.0.1:%d' % _free_port()
     env = dict(os.environ)
