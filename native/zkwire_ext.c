@@ -1,0 +1,519 @@
+/* zkwire_ext: CPython-extension decoder for the per-connection receive
+ * hot path.
+ *
+ * Why this exists (see tools/profile_hotpath.py for the numbers): the
+ * pure-Python scalar decode of a GET_DATA reply stream runs at ~15-25
+ * MiB/s, and >90% of that time is jute primitive reads — per-field
+ * struct.unpack_from calls, bounds checks, and dict/dataclass plumbing
+ * in zkstream_tpu/protocol/{jute,records}.py.  Framing alone is cheap
+ * (the plain-C-ABI scanner in zkwire.cpp covers it), so the profitable
+ * native boundary is the *whole* receive transform: accumulated bytes
+ * -> list of packet dicts, in one C pass.  That is the same span the
+ * reference executes per socket read (frame loop lib/zk-streams.js:
+ * 39-99 + reply parse lib/zk-buffer.js:275-370), and the host-side
+ * counterpart of the batched TPU pipeline (ops/pipeline.py).
+ *
+ * Contract (mirrors PacketCodec.decode exactly; A/B-tested in
+ * tests/test_native_ext.py):
+ *
+ *   decode_responses(buf, xid_map, max_packet)
+ *     -> (pkts, consumed, err_kind, err_msg)
+ *
+ * - Slices every complete length-prefixed frame out of buf[0:len];
+ *   `consumed` is the byte offset the caller must drop from its
+ *   accumulation buffer.
+ * - Each frame decodes to the same packet dict the Python codec builds:
+ *   xid/zxid/err + opcode-specific body fields (data/stat/path/children/
+ *   acl/type/state), with Stat/ACL/Id constructed through the Python
+ *   classes registered via setup().
+ * - Bad length prefix (negative or > max_packet): err_kind BAD_LENGTH,
+ *   consumed = offset of the offending prefix, pkts = [] (frames
+ *   before it are consumed-and-dropped — identical to
+ *   FrameDecoder.feed raising mid-scan).
+ * - Undecodable frame body: err_kind BAD_DECODE, pkts = packets decoded
+ *   before the bad frame (PacketCodec attaches them to the raised
+ *   error), consumed = all complete frames (they left the buffer in the
+ *   scalar path too).
+ * - xids are popped from xid_map exactly as records.read_response does.
+ *
+ * Built with a bare `gcc -shared -fPIC` against the interpreter's
+ * headers; loaded via utils/native.py with the same
+ * version-named-artifact discipline as the C-ABI scanner.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+/* ---- registered Python objects (held forever once set) ---- */
+
+static PyObject *g_stat_cls;    /* records.Stat */
+static PyObject *g_acl_cls;    /* records.ACL */
+static PyObject *g_id_cls;     /* records.Id */
+static PyObject *g_perm_cls;   /* consts.Perm (IntFlag) */
+static PyObject *g_err_names;  /* dict int -> str (ErrCode names) */
+static PyObject *g_notif_types; /* dict int -> str */
+static PyObject *g_states;     /* dict int -> str (KeeperState names) */
+static PyObject *g_layouts;    /* dict opcode-str -> layout int */
+
+/* interned key + special-opcode strings */
+static PyObject *s_xid, *s_zxid, *s_err, *s_opcode, *s_data, *s_stat,
+    *s_path, *s_children, *s_acl, *s_type, *s_state;
+static PyObject *s_notification, *s_ping, *s_auth, *s_set_watches, *s_ok;
+
+/* layout enum — the Python side builds g_layouts with these values */
+enum {
+  LAYOUT_EMPTY = 0,
+  LAYOUT_GET_CHILDREN = 1,
+  LAYOUT_GET_CHILDREN2 = 2,
+  LAYOUT_CREATE = 3,
+  LAYOUT_GET_ACL = 4,
+  LAYOUT_GET_DATA = 5,
+  LAYOUT_STAT_ONLY = 6,
+  LAYOUT_NOTIFICATION = 7,
+};
+
+/* ---- byte readers (big-endian, bounds-checked) ---- */
+
+typedef struct {
+  const uint8_t *p;
+  Py_ssize_t len;
+  Py_ssize_t off;
+  char err[192]; /* non-empty => decode error */
+} Cursor;
+
+static int need(Cursor *c, Py_ssize_t n) {
+  if (c->off + n > c->len) {
+    snprintf(c->err, sizeof(c->err),
+             "need %zd bytes at offset %zd, have %zd", n, c->off,
+             c->len - c->off);
+    return 0;
+  }
+  return 1;
+}
+
+static int32_t rd_i32(Cursor *c) {
+  const uint8_t *p = c->p + c->off;
+  c->off += 4;
+  return (int32_t)(((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                   ((uint32_t)p[2] << 8) | (uint32_t)p[3]);
+}
+
+static int64_t rd_i64(Cursor *c) {
+  const uint8_t *p = c->p + c->off;
+  c->off += 8;
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return (int64_t)v;
+}
+
+/* int-length-prefixed UTF-8 string; negative length => "" (the jute
+ * empty-buffer quirk, lib/jute-buffer.js:99-100). NULL on error. */
+static PyObject *rd_string(Cursor *c) {
+  if (!need(c, 4)) return NULL;
+  int32_t ln = rd_i32(c);
+  if (ln < 0) return PyUnicode_FromStringAndSize("", 0);
+  if (!need(c, ln)) return NULL;
+  PyObject *s =
+      PyUnicode_DecodeUTF8((const char *)c->p + c->off, ln, NULL);
+  if (s == NULL) {
+    /* surface as a decode error, not a raised exception */
+    PyErr_Clear();
+    snprintf(c->err, sizeof(c->err), "invalid utf-8 string at offset %zd",
+             c->off);
+    return NULL;
+  }
+  c->off += ln;
+  return s;
+}
+
+static PyObject *rd_bytes(Cursor *c) {
+  if (!need(c, 4)) return NULL;
+  int32_t ln = rd_i32(c);
+  if (ln < 0) return PyBytes_FromStringAndSize("", 0);
+  if (!need(c, ln)) return NULL;
+  PyObject *b =
+      PyBytes_FromStringAndSize((const char *)c->p + c->off, ln);
+  c->off += ln;
+  return b;
+}
+
+/* the 68-byte Stat record in one bounds check
+ * (reference: lib/zk-buffer.js:428-442).
+ *
+ * Stat is a NamedTuple, i.e. a tuple subclass, so the instance is
+ * built through tuple's own tp_new — the exact effect of
+ * `tuple.__new__(Stat, fields)` — skipping the generated Python-level
+ * __new__ (which costs ~10x the tuple itself on the hot path). */
+static PyObject *rd_stat(Cursor *c) {
+  if (!need(c, 68)) return NULL;
+  PyObject *vals = PyTuple_New(11);
+  if (vals == NULL) return NULL;
+#define STAT_FIELD(i, expr)                 \
+  do {                                      \
+    PyObject *v_ = (expr);                  \
+    if (v_ == NULL) {                       \
+      Py_DECREF(vals);                      \
+      return NULL;                          \
+    }                                       \
+    PyTuple_SET_ITEM(vals, (i), v_);        \
+  } while (0)
+  STAT_FIELD(0, PyLong_FromLongLong(rd_i64(c)));  /* czxid */
+  STAT_FIELD(1, PyLong_FromLongLong(rd_i64(c)));  /* mzxid */
+  STAT_FIELD(2, PyLong_FromLongLong(rd_i64(c)));  /* ctime */
+  STAT_FIELD(3, PyLong_FromLongLong(rd_i64(c)));  /* mtime */
+  STAT_FIELD(4, PyLong_FromLong(rd_i32(c)));      /* version */
+  STAT_FIELD(5, PyLong_FromLong(rd_i32(c)));      /* cversion */
+  STAT_FIELD(6, PyLong_FromLong(rd_i32(c)));      /* aversion */
+  STAT_FIELD(7, PyLong_FromLongLong(rd_i64(c)));  /* ephemeralOwner */
+  STAT_FIELD(8, PyLong_FromLong(rd_i32(c)));      /* dataLength */
+  STAT_FIELD(9, PyLong_FromLong(rd_i32(c)));      /* numChildren */
+  STAT_FIELD(10, PyLong_FromLongLong(rd_i64(c))); /* pzxid */
+#undef STAT_FIELD
+  PyObject *args = PyTuple_Pack(1, vals);
+  Py_DECREF(vals);
+  if (args == NULL) return NULL;
+  PyObject *stat =
+      PyTuple_Type.tp_new((PyTypeObject *)g_stat_cls, args, NULL);
+  Py_DECREF(args);
+  return stat;
+}
+
+/* dict[int] lookup helper; returns borrowed ref or NULL (no exception) */
+static PyObject *int_key_get(PyObject *dict, long long key) {
+  PyObject *k = PyLong_FromLongLong(key);
+  if (k == NULL) return NULL;
+  PyObject *v = PyDict_GetItemWithError(dict, k); /* borrowed */
+  Py_DECREF(k);
+  if (v == NULL) PyErr_Clear();
+  return v;
+}
+
+/* set pkt[key] = val, stealing val; -1 on failure (val still released) */
+static int set_steal(PyObject *pkt, PyObject *key, PyObject *val) {
+  if (val == NULL) return -1;
+  int r = PyDict_SetItem(pkt, key, val);
+  Py_DECREF(val);
+  return r;
+}
+
+/* ---- one reply body ---- */
+
+static int decode_body(Cursor *c, PyObject *pkt, int layout) {
+  switch (layout) {
+    case LAYOUT_EMPTY:
+      return 0;
+    case LAYOUT_CREATE:
+      return set_steal(pkt, s_path, rd_string(c));
+    case LAYOUT_STAT_ONLY:
+      return set_steal(pkt, s_stat, rd_stat(c));
+    case LAYOUT_GET_DATA: {
+      if (set_steal(pkt, s_data, rd_bytes(c)) < 0) return -1;
+      return set_steal(pkt, s_stat, rd_stat(c));
+    }
+    case LAYOUT_GET_CHILDREN:
+    case LAYOUT_GET_CHILDREN2: {
+      if (!need(c, 4)) return -1;
+      int32_t n = rd_i32(c);
+      if (n < 0) n = 0;
+      PyObject *lst = PyList_New(n);
+      if (lst == NULL) return -1;
+      for (int32_t i = 0; i < n; ++i) {
+        PyObject *s = rd_string(c);
+        if (s == NULL) {
+          Py_DECREF(lst);
+          return -1;
+        }
+        PyList_SET_ITEM(lst, i, s);
+      }
+      if (set_steal(pkt, s_children, lst) < 0) return -1;
+      if (layout == LAYOUT_GET_CHILDREN2)
+        return set_steal(pkt, s_stat, rd_stat(c));
+      return 0;
+    }
+    case LAYOUT_GET_ACL: {
+      if (!need(c, 4)) return -1;
+      int32_t n = rd_i32(c);
+      if (n < 0) n = 0;
+      PyObject *lst = PyList_New(n);
+      if (lst == NULL) return -1;
+      for (int32_t i = 0; i < n; ++i) {
+        if (!need(c, 4)) {
+          Py_DECREF(lst);
+          return -1;
+        }
+        int32_t perms = rd_i32(c);
+        PyObject *scheme = rd_string(c);
+        PyObject *ident = scheme ? rd_string(c) : NULL;
+        PyObject *entry = NULL;
+        if (ident != NULL) {
+          PyObject *id_obj =
+              PyObject_CallFunction(g_id_cls, "OO", scheme, ident);
+          PyObject *perm_obj =
+              id_obj ? PyObject_CallFunction(g_perm_cls, "i", perms)
+                     : NULL;
+          if (perm_obj != NULL)
+            entry = PyObject_CallFunction(g_acl_cls, "OO", perm_obj,
+                                          id_obj);
+          Py_XDECREF(perm_obj);
+          Py_XDECREF(id_obj);
+        }
+        Py_XDECREF(scheme);
+        Py_XDECREF(ident);
+        if (entry == NULL) {
+          Py_DECREF(lst);
+          return -1;
+        }
+        PyList_SET_ITEM(lst, i, entry);
+      }
+      if (set_steal(pkt, s_acl, lst) < 0) return -1;
+      return set_steal(pkt, s_stat, rd_stat(c));
+    }
+    case LAYOUT_NOTIFICATION: {
+      if (!need(c, 8)) return -1;
+      int32_t type = rd_i32(c);
+      int32_t state = rd_i32(c);
+      PyObject *tname = int_key_get(g_notif_types, type);
+      if (tname == NULL) {
+        snprintf(c->err, sizeof(c->err), "%d is not a valid notification "
+                 "type", type);
+        return -1;
+      }
+      PyObject *sname = int_key_get(g_states, state);
+      if (sname == NULL) {
+        snprintf(c->err, sizeof(c->err), "%d is not a valid keeper state",
+                 state);
+        return -1;
+      }
+      if (PyDict_SetItem(pkt, s_type, tname) < 0) return -1;
+      if (PyDict_SetItem(pkt, s_state, sname) < 0) return -1;
+      return set_steal(pkt, s_path, rd_string(c));
+    }
+    default:
+      snprintf(c->err, sizeof(c->err), "unknown layout %d", layout);
+      return -1;
+  }
+}
+
+/* ---- one frame -> packet dict (NULL + c->err / exception on error) -- */
+
+static PyObject *decode_reply(Cursor *c, PyObject *xid_map) {
+  if (!need(c, 16)) return NULL;
+  int32_t xid = rd_i32(c);
+  int64_t zxid = rd_i64(c);
+  int32_t errc = rd_i32(c);
+
+  PyObject *pkt = PyDict_New();
+  if (pkt == NULL) return NULL;
+
+  PyObject *opcode = NULL; /* borrowed or owned; track via owned flag */
+  int opcode_owned = 0;
+  switch (xid) { /* SPECIAL_XIDS (lib/zk-consts.js:135-138) */
+    case -1: opcode = s_notification; break;
+    case -2: opcode = s_ping; break;
+    case -4: opcode = s_auth; break;
+    case -8: opcode = s_set_watches; break;
+    default: {
+      PyObject *k = PyLong_FromLong(xid);
+      if (k == NULL) goto fail;
+      /* one reply per xid: pop, matching records.read_response
+       * (get+del; PyDict_Pop is not public until 3.13) */
+      opcode = PyDict_GetItemWithError(xid_map, k); /* borrowed */
+      if (opcode == NULL) {
+        Py_DECREF(k);
+        if (PyErr_Occurred()) goto fail;
+        snprintf(c->err, sizeof(c->err),
+                 "reply xid %d matches no request", xid);
+        goto fail;
+      }
+      Py_INCREF(opcode);
+      opcode_owned = 1;
+      if (PyDict_DelItem(xid_map, k) < 0) {
+        Py_DECREF(k);
+        goto fail;
+      }
+      Py_DECREF(k);
+    }
+  }
+
+  if (set_steal(pkt, s_xid, PyLong_FromLong(xid)) < 0) goto fail;
+  if (set_steal(pkt, s_zxid, PyLong_FromLongLong(zxid)) < 0) goto fail;
+  PyObject *err_name = errc == 0 ? s_ok : int_key_get(g_err_names, errc);
+  if (err_name != NULL) {
+    if (PyDict_SetItem(pkt, s_err, err_name) < 0) goto fail;
+  } else { /* unknown code -> 'ERROR_%d' (consts.err_name) */
+    if (set_steal(pkt, s_err, PyUnicode_FromFormat("ERROR_%d", errc)) < 0)
+      goto fail;
+  }
+  if (PyDict_SetItem(pkt, s_opcode, opcode) < 0) goto fail;
+
+  if (errc == 0) {
+    PyObject *layout = PyDict_GetItemWithError(g_layouts, opcode);
+    if (layout == NULL) {
+      if (PyErr_Occurred()) goto fail;
+      snprintf(c->err, sizeof(c->err), "unsupported reply opcode");
+      goto fail;
+    }
+    if (decode_body(c, pkt, (int)PyLong_AsLong(layout)) < 0) goto fail;
+  }
+  if (opcode_owned) Py_DECREF(opcode);
+  return pkt;
+
+fail:
+  if (opcode_owned) Py_XDECREF(opcode);
+  Py_DECREF(pkt);
+  return NULL;
+}
+
+/* ---- module functions ---- */
+
+static PyObject *py_setup(PyObject *self, PyObject *args) {
+  PyObject *stat_cls, *acl_cls, *id_cls, *perm_cls, *err_names,
+      *notif_types, *states, *layouts;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO", &stat_cls, &acl_cls, &id_cls,
+                        &perm_cls, &err_names, &notif_types, &states,
+                        &layouts))
+    return NULL;
+  /* rd_stat builds instances through tuple's tp_new */
+  if (!PyType_Check(stat_cls) ||
+      !PyType_IsSubtype((PyTypeObject *)stat_cls, &PyTuple_Type)) {
+    PyErr_SetString(PyExc_TypeError, "Stat must be a tuple subclass");
+    return NULL;
+  }
+  Py_INCREF(stat_cls); Py_XSETREF(g_stat_cls, stat_cls);
+  Py_INCREF(acl_cls); Py_XSETREF(g_acl_cls, acl_cls);
+  Py_INCREF(id_cls); Py_XSETREF(g_id_cls, id_cls);
+  Py_INCREF(perm_cls); Py_XSETREF(g_perm_cls, perm_cls);
+  Py_INCREF(err_names); Py_XSETREF(g_err_names, err_names);
+  Py_INCREF(notif_types); Py_XSETREF(g_notif_types, notif_types);
+  Py_INCREF(states); Py_XSETREF(g_states, states);
+  Py_INCREF(layouts); Py_XSETREF(g_layouts, layouts);
+  Py_RETURN_NONE;
+}
+
+static PyObject *py_decode_responses(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  PyObject *xid_map;
+  int max_packet;
+  if (!PyArg_ParseTuple(args, "y*O!i", &view, &PyDict_Type, &xid_map,
+                        &max_packet))
+    return NULL;
+  if (g_stat_cls == NULL) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_RuntimeError, "setup() not called");
+    return NULL;
+  }
+
+  const uint8_t *buf = (const uint8_t *)view.buf;
+  Py_ssize_t len = view.len;
+
+  PyObject *pkts = PyList_New(0);
+  if (pkts == NULL) {
+    PyBuffer_Release(&view);
+    return NULL;
+  }
+
+  const char *err_kind = NULL;
+  char err_msg[256] = {0};
+  Py_ssize_t consumed = 0;
+
+  /* pass 1: frame boundaries (so a bad prefix drops earlier frames
+   * exactly like FrameDecoder.feed raising mid-scan) */
+  Py_ssize_t off = 0, end_of_frames = 0;
+  while (len - off >= 4) {
+    int32_t ln = (int32_t)(((uint32_t)buf[off] << 24) |
+                           ((uint32_t)buf[off + 1] << 16) |
+                           ((uint32_t)buf[off + 2] << 8) |
+                           (uint32_t)buf[off + 3]);
+    if (ln < 0 || ln > max_packet) {
+      err_kind = "BAD_LENGTH";
+      snprintf(err_msg, sizeof(err_msg), "Invalid ZK packet length %d",
+               ln);
+      consumed = off;
+      goto done;
+    }
+    if (len - off < 4 + (Py_ssize_t)ln) break;
+    off += 4 + ln;
+    end_of_frames = off;
+  }
+  consumed = end_of_frames;
+
+  /* pass 2: decode each frame body */
+  off = 0;
+  while (off < end_of_frames) {
+    int32_t ln = (int32_t)(((uint32_t)buf[off] << 24) |
+                           ((uint32_t)buf[off + 1] << 16) |
+                           ((uint32_t)buf[off + 2] << 8) |
+                           (uint32_t)buf[off + 3]);
+    Cursor c = {buf + off + 4, ln, 0, {0}};
+    PyObject *pkt = decode_reply(&c, xid_map);
+    if (pkt == NULL) {
+      if (PyErr_Occurred()) { /* real exception (OOM etc.) */
+        Py_DECREF(pkts);
+        PyBuffer_Release(&view);
+        return NULL;
+      }
+      err_kind = "BAD_DECODE";
+      snprintf(err_msg, sizeof(err_msg), "Failed to decode Response: %s",
+               c.err);
+      goto done;
+    }
+    if (PyList_Append(pkts, pkt) < 0) {
+      Py_DECREF(pkt);
+      Py_DECREF(pkts);
+      PyBuffer_Release(&view);
+      return NULL;
+    }
+    Py_DECREF(pkt);
+    off += 4 + ln;
+  }
+
+done:
+  PyBuffer_Release(&view);
+  PyObject *ret =
+      err_kind == NULL
+          ? Py_BuildValue("(OnOO)", pkts, consumed, Py_None, Py_None)
+          : Py_BuildValue("(Onss)", pkts, consumed, err_kind, err_msg);
+  Py_DECREF(pkts); /* BuildValue's "O" took its own reference */
+  return ret;
+}
+
+static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
+  return PyLong_FromLong(1);
+}
+
+static PyMethodDef methods[] = {
+    {"setup", py_setup, METH_VARARGS,
+     "setup(Stat, ACL, Id, Perm, err_names, notif_types, states, "
+     "layouts)"},
+    {"decode_responses", py_decode_responses, METH_VARARGS,
+     "decode_responses(buf, xid_map, max_packet) -> "
+     "(pkts, consumed, err_kind, err_msg)"},
+    {"abi_version", py_abi_version, METH_NOARGS, "native ABI version"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_zkwire_ext",
+    "C decoder for the zkstream_tpu receive hot path", -1, methods};
+
+PyMODINIT_FUNC PyInit__zkwire_ext(void) {
+  s_xid = PyUnicode_InternFromString("xid");
+  s_zxid = PyUnicode_InternFromString("zxid");
+  s_err = PyUnicode_InternFromString("err");
+  s_opcode = PyUnicode_InternFromString("opcode");
+  s_data = PyUnicode_InternFromString("data");
+  s_stat = PyUnicode_InternFromString("stat");
+  s_path = PyUnicode_InternFromString("path");
+  s_children = PyUnicode_InternFromString("children");
+  s_acl = PyUnicode_InternFromString("acl");
+  s_type = PyUnicode_InternFromString("type");
+  s_state = PyUnicode_InternFromString("state");
+  s_notification = PyUnicode_InternFromString("NOTIFICATION");
+  s_ping = PyUnicode_InternFromString("PING");
+  s_auth = PyUnicode_InternFromString("AUTH");
+  s_set_watches = PyUnicode_InternFromString("SET_WATCHES");
+  s_ok = PyUnicode_InternFromString("OK");
+  return PyModule_Create(&moduledef);
+}

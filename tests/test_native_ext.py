@@ -1,0 +1,264 @@
+"""A/B tests: the C-extension decoder (native/zkwire_ext.c) against the
+pure-Python codec, which is the semantic spec.
+
+The extension covers the steady-state client receive path — framing +
+reply-body decode in one native pass (the boundary the profile in
+tools/profile_hotpath.py justifies).  Every test drives both
+implementations over identical bytes and asserts identical packets,
+identical buffer state, and identical error behavior, including the
+lossy corners (frames sharing a chunk with a bad frame).
+"""
+
+from __future__ import annotations
+
+import random
+import struct
+
+import pytest
+
+from zkstream_tpu.protocol import records
+from zkstream_tpu.protocol.errors import ZKProtocolError
+from zkstream_tpu.protocol.framing import PacketCodec
+from zkstream_tpu.utils import native
+
+if native.ensure_ext() is None:  # pragma: no cover - no compiler
+    pytest.skip('native extension unavailable', allow_module_level=True)
+
+
+STAT = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, 3, 2, 8)
+
+ALL_REPLIES = [
+    {'xid': 1, 'zxid': 100, 'opcode': 'GET_DATA', 'err': 'OK',
+     'data': b'abc', 'stat': STAT},
+    {'xid': 2, 'zxid': 101, 'opcode': 'EXISTS', 'err': 'OK',
+     'stat': STAT},
+    {'xid': 3, 'zxid': 102, 'opcode': 'SET_DATA', 'err': 'OK',
+     'stat': STAT},
+    {'xid': 4, 'zxid': 103, 'opcode': 'CREATE', 'err': 'OK',
+     'path': '/a/b'},
+    {'xid': 5, 'zxid': 104, 'opcode': 'GET_CHILDREN2', 'err': 'OK',
+     'children': ['x', 'y'], 'stat': STAT},
+    {'xid': 6, 'zxid': 105, 'opcode': 'GET_CHILDREN', 'err': 'OK',
+     'children': []},
+    {'xid': 7, 'zxid': 106, 'opcode': 'GET_ACL', 'err': 'OK',
+     'acl': list(records.OPEN_ACL_UNSAFE), 'stat': STAT},
+    {'xid': 8, 'zxid': 107, 'opcode': 'DELETE', 'err': 'OK'},
+    {'xid': 9, 'zxid': 108, 'opcode': 'GET_DATA', 'err': 'NO_NODE'},
+    {'xid': -1, 'zxid': 109, 'opcode': 'NOTIFICATION', 'err': 'OK',
+     'type': 'DATA_CHANGED', 'state': 'SYNC_CONNECTED', 'path': '/a'},
+    {'xid': -2, 'zxid': 110, 'opcode': 'PING', 'err': 'OK'},
+    {'xid': 10, 'zxid': 111, 'opcode': 'SYNC', 'err': 'OK'},
+    {'xid': 11, 'zxid': 112, 'opcode': 'SET_WATCHES', 'err': 'OK'},
+]
+
+
+def encode_replies(replies) -> bytes:
+    enc = PacketCodec(server=True)
+    enc.handshaking = False
+    return b''.join(enc.encode(p) for p in replies)
+
+
+def xid_map_for(replies) -> dict:
+    return {p['xid']: p['opcode'] for p in replies if p['xid'] > 0}
+
+
+def mk_codec(use_native: bool, replies=ALL_REPLIES) -> PacketCodec:
+    c = PacketCodec(use_native=use_native)
+    c.handshaking = False
+    c.xid_map = xid_map_for(replies)
+    return c
+
+
+def decode_both(wire: bytes, replies=ALL_REPLIES):
+    """Run both decoders over the same bytes; return (py, ext) codecs
+    and their outcomes (packets list or raised error)."""
+    out = []
+    for use_native in (False, True):
+        c = mk_codec(use_native, replies)
+        try:
+            res = ('ok', c.decode(wire))
+        except ZKProtocolError as e:
+            res = ('err', e)
+        out.append((c, res))
+    (py, py_res), (ext, ext_res) = out
+    assert ext._ext is not None, 'extension did not engage'
+    return py, py_res, ext, ext_res
+
+
+def test_all_opcodes_equivalent():
+    wire = encode_replies(ALL_REPLIES)
+    py, (k1, a), ext, (k2, b) = decode_both(wire)
+    assert k1 == k2 == 'ok'
+    assert a == b
+    assert len(a) == len(ALL_REPLIES)
+    assert py.xid_map == ext.xid_map == {}
+    assert type(b[0]['stat']) is records.Stat
+    assert isinstance(b[6]['acl'][0], records.ACL)
+
+
+def test_byte_at_a_time_feed():
+    wire = encode_replies(ALL_REPLIES)
+    whole = mk_codec(True).decode(wire)
+    c = mk_codec(True)
+    got = []
+    for i in range(len(wire)):
+        got += c.decode(wire[i:i + 1])
+    assert got == whole
+    assert c._decoder.pending() == 0
+
+
+def test_unknown_error_code_formats_like_python():
+    replies = [{'xid': 1, 'zxid': 1, 'opcode': 'GET_DATA',
+                'err': 'OK', 'data': b'', 'stat': STAT}]
+    wire = bytearray(encode_replies(replies))
+    # overwrite the err field (bytes 4+16..4+20 == header offset 16)
+    struct.pack_into('>i', wire, 4 + 12, -31337)
+    py, (k1, a), ext, (k2, b) = decode_both(bytes(wire), replies)
+    assert k1 == k2 == 'ok'
+    assert a == b
+    assert b[0]['err'] == 'ERROR_-31337'
+
+
+def test_bad_length_matches_scalar_contract():
+    """[good frame][bad prefix]: the good frame is consumed-and-dropped,
+    the buffer is left at the offending prefix, no xids are popped."""
+    replies = ALL_REPLIES[:1]
+    good = encode_replies(replies)
+    wire = good + struct.pack('>i', -5) + b'junk'
+    py, (k1, e1), ext, (k2, e2) = decode_both(wire, replies)
+    assert k1 == k2 == 'err'
+    assert e1.code == e2.code == 'BAD_LENGTH'
+    assert getattr(e1, 'packets', []) == getattr(e2, 'packets', [])
+    assert py._decoder.pending() == ext._decoder.pending() == \
+        len(wire) - len(good)
+    assert py.xid_map == ext.xid_map  # nothing popped by either
+
+
+def test_bad_body_preserves_earlier_packets():
+    """[good][truncated-body][good]: packets before the bad frame ride
+    on the error; the frame after it is lost in both implementations
+    (BAD_DECODE is connection-fatal, the buffer is already drained)."""
+    replies = ALL_REPLIES[:3]
+    f1 = encode_replies(replies[:1])
+    # valid framing, body truncated mid-stat: header + 4 bytes
+    bad_body = struct.pack('>iqi', 2, 5, 0) + b'\x00' * 4
+    f2 = struct.pack('>i', len(bad_body)) + bad_body
+    f3 = encode_replies(replies[2:3])
+    wire = f1 + f2 + f3
+    py, (k1, e1), ext, (k2, e2) = decode_both(wire, replies)
+    assert k1 == k2 == 'err'
+    assert e1.code == e2.code == 'BAD_DECODE'
+    assert e1.packets == e2.packets
+    assert len(e1.packets) == 1 and e1.packets[0]['xid'] == 1
+    assert py._decoder.pending() == ext._decoder.pending() == 0
+    assert py.xid_map == ext.xid_map  # f3's xid still armed in both
+
+
+def test_unmatched_xid_is_bad_decode():
+    replies = [{'xid': 77, 'zxid': 1, 'opcode': 'DELETE', 'err': 'OK'}]
+    wire = encode_replies(replies)
+    py, (k1, e1), ext, (k2, e2) = decode_both(wire, [])
+    assert k1 == k2 == 'err'
+    assert e1.code == e2.code == 'BAD_DECODE'
+    assert 'matches no request' in str(e2)
+
+
+def test_unknown_notification_type_is_bad_decode():
+    body = struct.pack('>iqi', -1, 5, 0) + struct.pack('>ii', 99, 3) \
+        + struct.pack('>i', 2) + b'/x'
+    wire = struct.pack('>i', len(body)) + body
+    py, (k1, e1), ext, (k2, e2) = decode_both(wire, [])
+    assert k1 == k2 == 'err'
+    assert e1.code == e2.code == 'BAD_DECODE'
+
+
+def test_handshake_stays_on_python_path():
+    """While handshaking the extension must not engage: the connect
+    exchange decodes via the Python codec in both modes, with identical
+    outcomes — including the defensive error when a segment coalesces
+    extra frames with the handshake (the connection layer treats >1
+    packet during the connect phase as fatal, mirroring the single-
+    ConnectResponse validation of the reference's connection FSM)."""
+    enc = PacketCodec(server=True)
+    hs = enc.encode({'protocolVersion': 0, 'timeOut': 30000,
+                     'sessionId': 7, 'passwd': b'p' * 16})
+    enc.handshaking = False
+    reply = enc.encode({'xid': 1, 'zxid': 9, 'opcode': 'DELETE',
+                        'err': 'OK'})
+
+    outcomes = []
+    for use_native in (False, True):
+        c = PacketCodec(use_native=use_native)
+        c.xid_map = {1: 'DELETE'}
+        pkts = c.decode(hs)
+        assert pkts[0]['sessionId'] == 7
+        c.handshaking = False
+        outcomes.append(c.decode(reply))
+    assert outcomes[0] == outcomes[1] == [
+        {'xid': 1, 'zxid': 9, 'opcode': 'DELETE', 'err': 'OK'}]
+
+    # coalesced handshake+reply: identical (error) behavior both modes
+    results = []
+    for use_native in (False, True):
+        c = PacketCodec(use_native=use_native)
+        c.xid_map = {1: 'DELETE'}
+        try:
+            results.append(('ok', c.decode(hs + reply)))
+        except ZKProtocolError as e:
+            results.append(('err', e.code))
+    assert results[0] == results[1]
+
+
+def test_randomized_fleet_equivalence():
+    rng = random.Random(1234)
+    opcodes = ['GET_DATA', 'EXISTS', 'SET_DATA', 'CREATE', 'DELETE',
+               'GET_CHILDREN', 'GET_CHILDREN2', 'GET_ACL', 'SYNC']
+    for _ in range(25):
+        replies = []
+        xid = 0
+        for _ in range(rng.randrange(1, 40)):
+            if rng.random() < 0.15:
+                replies.append({
+                    'xid': -1, 'zxid': rng.randrange(1 << 40),
+                    'opcode': 'NOTIFICATION', 'err': 'OK',
+                    'type': rng.choice(['CREATED', 'DELETED',
+                                        'DATA_CHANGED',
+                                        'CHILDREN_CHANGED']),
+                    'state': 'SYNC_CONNECTED',
+                    'path': '/' + 'x' * rng.randrange(1, 30)})
+                continue
+            xid += 1
+            op = rng.choice(opcodes)
+            pkt = {'xid': xid, 'zxid': rng.randrange(1 << 40),
+                   'opcode': op, 'err': 'OK'}
+            if rng.random() < 0.2:
+                pkt['err'] = 'NO_NODE'
+            else:
+                st = records.Stat(*[rng.randrange(1 << 30)
+                                    for _ in range(11)])
+                if op == 'GET_DATA':
+                    pkt['data'] = rng.randbytes(rng.randrange(200))
+                    pkt['stat'] = st
+                elif op in ('EXISTS', 'SET_DATA'):
+                    pkt['stat'] = st
+                elif op == 'CREATE':
+                    pkt['path'] = '/n%d' % xid
+                elif op in ('GET_CHILDREN', 'GET_CHILDREN2'):
+                    pkt['children'] = ['c%d' % i for i in
+                                       range(rng.randrange(5))]
+                    if op == 'GET_CHILDREN2':
+                        pkt['stat'] = st
+                elif op == 'GET_ACL':
+                    pkt['acl'] = list(records.OPEN_ACL_UNSAFE)
+                    pkt['stat'] = st
+            replies.append(pkt)
+        wire = encode_replies(replies)
+        py, (k1, a), ext, (k2, b) = decode_both(wire, replies)
+        assert k1 == k2 == 'ok'
+        assert a == b
+        assert py.xid_map == ext.xid_map
+        # random split points must not change the result
+        c = mk_codec(True, replies)
+        cut = rng.randrange(len(wire))
+        got = c.decode(wire[:cut]) + c.decode(wire[cut:])
+        assert got == b

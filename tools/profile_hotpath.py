@@ -1,0 +1,113 @@
+"""Profile the scalar (per-connection) decode hot path.
+
+Answers the question "where does the Python codec actually spend its
+time, and what native boundary does that justify?" — the methodology
+and conclusions are written up in PROFILE.md; this script reproduces
+them.
+
+Three tiers over the same GET_DATA reply stream (the dominant packet
+shape of a read-heavy ZK workload: 16-byte header + data buffer +
+68-byte Stat):
+
+  framing   FrameDecoder only (what native/zkwire.cpp accelerates)
+  python    full PacketCodec decode, pure Python
+  ext       full PacketCodec decode via the C extension
+            (native/zkwire_ext.c), when buildable
+
+plus a cProfile breakdown of the pure-Python tier, so the "jute
+primitive reads dominate" claim stays checkable as the code evolves.
+
+Usage:  python tools/profile_hotpath.py [--frames N] [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import pstats
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+
+from zkstream_tpu.protocol import records                    # noqa: E402
+from zkstream_tpu.protocol.framing import (                  # noqa: E402
+    FrameDecoder,
+    PacketCodec,
+)
+from zkstream_tpu.utils import native                        # noqa: E402
+
+
+def mk_stream(frames: int, data_len: int = 64) -> bytes:
+    st = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, data_len, 0, 8)
+    enc = PacketCodec(server=True)
+    enc.handshaking = False
+    return b''.join(
+        enc.encode({'xid': i + 1, 'zxid': 1000 + i, 'opcode': 'GET_DATA',
+                    'err': 'OK', 'data': b'd' * data_len, 'stat': st})
+        for i in range(frames))
+
+
+def tier_framing(stream: bytes, frames: int) -> None:
+    dec = FrameDecoder(use_native=False)
+    for _ in dec.feed(stream):
+        pass
+
+
+def tier_codec(stream: bytes, frames: int,
+               use_native: bool) -> None:
+    c = PacketCodec(use_native=use_native)
+    c.handshaking = False
+    c.xid_map = {i + 1: 'GET_DATA' for i in range(frames)}
+    c.decode(stream)
+
+
+def measure(fn, stream: bytes, frames: int, reps: int) -> float:
+    """Best-of-trials MiB/s (this image runs on one shared core; min
+    over interleaved trials rejects scheduling noise)."""
+    best = float('inf')
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn(stream, frames)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return len(stream) / best / (1 << 20)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--frames', type=int, default=2000)
+    ap.add_argument('--reps', type=int, default=20)
+    args = ap.parse_args()
+
+    stream = mk_stream(args.frames)
+    print('stream: %d frames, %d bytes' % (args.frames, len(stream)))
+
+    tiers = [('framing-only (python)', tier_framing),
+             ('full-decode (python)',
+              lambda s, f: tier_codec(s, f, use_native=False))]
+    if native.ensure_ext() is not None:
+        tiers.append(('full-decode (C ext)',
+                      lambda s, f: tier_codec(s, f, use_native=True)))
+    else:
+        print('C extension unavailable; skipping ext tier')
+
+    for name, fn in tiers:
+        mibs = measure(fn, stream, args.frames, args.reps)
+        us = len(stream) / (mibs * (1 << 20)) / args.frames * 1e6
+        print('%-22s %8.1f MiB/s  (%.2f us/frame)' % (name, mibs, us))
+
+    print('\ncProfile of full-decode (python), top 12 by tottime:')
+    pr = cProfile.Profile()
+    pr.enable()
+    for _ in range(args.reps):
+        tier_codec(stream, args.frames, use_native=False)
+    pr.disable()
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats('tottime').print_stats(12)
+    print('\n'.join(s.getvalue().splitlines()[4:22]))
+
+
+if __name__ == '__main__':
+    main()

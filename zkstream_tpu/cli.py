@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import dataclasses
 import sys
 
 from .client import Client
@@ -63,8 +62,8 @@ def _parse_servers(value: str) -> list[dict]:
 
 
 def _print_stat(stat: Stat) -> None:
-    for field in dataclasses.fields(Stat):
-        print('%s = %s' % (field.name, getattr(stat, field.name)))
+    for name in Stat._fields:
+        print('%s = %s' % (name, getattr(stat, name)))
 
 
 async def _run(args) -> int:

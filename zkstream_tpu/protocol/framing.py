@@ -134,6 +134,15 @@ class PacketCodec:
         #: xid -> opcode for replies in flight
         #: (reference: lib/zk-streams.js:145, connection-fsm.js:74).
         self.xid_map: dict[int, str] = {}
+        # The C-extension decoder covers the client receive direction
+        # (steady-state replies) — the profiled hot path; handshake and
+        # server-direction decode stay in Python.  Best-effort: absent
+        # extension degrades to the scalar path.
+        self._ext = None
+        if not server and use_native is not False:
+            from ..utils import native
+            self._ext = (native.ensure_ext() if use_native
+                         else native.get_ext())
 
     def encode(self, pkt: dict) -> bytes:
         """Encode one outgoing packet to framed wire bytes."""
@@ -169,6 +178,8 @@ class PacketCodec:
         them (e.g. a watch notification sharing a TCP segment with a
         corrupt frame must not be lost — ZK will never refire it).
         """
+        if self._ext is not None and not self.handshaking:
+            return self._decode_ext(chunk)
         pkts: list[dict] = []
         for body in self._decoder.feed(chunk):
             r = JuteReader(body)
@@ -196,4 +207,23 @@ class PacketCodec:
                 err.packets = pkts
                 raise err
             pkts.append(pkt)
+        return pkts
+
+    def _decode_ext(self, chunk: bytes) -> list[dict]:
+        """Steady-state client receive via the C extension: framing +
+        reply decode in one native pass over the accumulation buffer.
+        Shares the FrameDecoder's buffer so handing a connection between
+        paths (handshake -> steady state, ingest take/restore_pending)
+        stays seamless; error semantics mirror the Python path
+        (A/B-tested in tests/test_native_ext.py)."""
+        buf = self._decoder._buf
+        buf += chunk
+        pkts, consumed, kind, msg = self._ext.decode_responses(
+            buf, self.xid_map, MAX_PACKET)
+        if consumed:
+            del buf[:consumed]
+        if kind is not None:
+            err = ZKProtocolError(kind, msg)
+            err.packets = pkts
+            raise err
         return pkts

@@ -18,6 +18,8 @@ defines) — this codec is fully symmetric: ``encode_response`` /
 from __future__ import annotations
 
 import dataclasses
+import struct
+import typing
 
 from .consts import (
     SPECIAL_XIDS,
@@ -53,10 +55,14 @@ class ACL:
 OPEN_ACL_UNSAFE = (ACL(Perm.ALL, Id('world', 'anyone')),)
 
 
-@dataclasses.dataclass(frozen=True)
-class Stat:
+class Stat(typing.NamedTuple):
     """The 11-field znode stat record (reference: lib/zk-buffer.js:428-442).
-    ``ctime``/``mtime`` are milliseconds since the epoch."""
+    ``ctime``/``mtime`` are milliseconds since the epoch.
+
+    A NamedTuple, not a dataclass: immutable and field-named either way,
+    but tuple construction happens in C — the decode hot path builds one
+    per stat-bearing reply, and a frozen dataclass pays ~11 Python-level
+    ``object.__setattr__`` calls each (see tools/profile_hotpath.py)."""
 
     czxid: int = 0
     mzxid: int = 0
@@ -71,20 +77,13 @@ class Stat:
     pzxid: int = 0
 
 
+#: The Stat record's fixed 68-byte layout, decoded in one call — field
+#: order matches the Stat dataclass exactly.
+_STAT_STRUCT = struct.Struct('>qqqqiiiqiiq')
+
+
 def read_stat(r: JuteReader) -> Stat:
-    return Stat(
-        czxid=r.read_long(),
-        mzxid=r.read_long(),
-        ctime=r.read_long(),
-        mtime=r.read_long(),
-        version=r.read_int(),
-        cversion=r.read_int(),
-        aversion=r.read_int(),
-        ephemeralOwner=r.read_long(),
-        dataLength=r.read_int(),
-        numChildren=r.read_int(),
-        pzxid=r.read_long(),
-    )
+    return Stat(*r.read_struct(_STAT_STRUCT))
 
 
 def write_stat(w: JuteWriter, s: Stat) -> None:
@@ -344,14 +343,17 @@ _RESP_READERS = {
 }
 
 
+#: The 16-byte reply header (xid:int32, zxid:int64, err:int32), decoded
+#: in one call (reference: lib/zk-buffer.js:281-289).
+_REPLY_HDR_STRUCT = struct.Struct('>iqi')
+
+
 def read_response(r: JuteReader, xid_map: dict[int, str]) -> dict:
     """Decode a reply.  The opcode comes from the special-xid table for
     reserved xids, otherwise from the caller's xid -> opcode map recorded
     at encode time (reference: lib/zk-buffer.js:281-331)."""
-    pkt: dict = {}
-    pkt['xid'] = r.read_int()
-    pkt['zxid'] = r.read_long()
-    pkt['err'] = err_name(r.read_int())
+    xid, zxid, errc = r.read_struct(_REPLY_HDR_STRUCT)
+    pkt: dict = {'xid': xid, 'zxid': zxid, 'err': err_name(errc)}
     opcode = SPECIAL_XIDS.get(pkt['xid'])
     if opcode is None:
         # One reply per xid: pop so the map cannot grow without bound

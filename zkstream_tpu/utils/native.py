@@ -141,6 +141,141 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
     return get_lib()
 
 
+# -- CPython-extension decoder (native/zkwire_ext.c) ------------------
+#
+# Separate artifact from the C-ABI scanner: it links against the
+# interpreter ABI (Python.h), decodes whole accumulation buffers into
+# packet dicts (framing + reply bodies in one C pass — the boundary the
+# profile in tools/profile_hotpath.py points at), and is loaded with the
+# same version-named-artifact / background-build discipline.
+
+_EXT_ABI_VERSION = 1
+
+_ext = None
+_ext_load_failed = False
+_ext_builder: threading.Thread | None = None
+
+
+def ext_source_path() -> str:
+    return os.path.join(_root(), 'native', 'zkwire_ext.c')
+
+
+def ext_path() -> str:
+    import sysconfig
+    tag = sysconfig.get_config_var('SOABI') or 'abi3'
+    return os.path.join(_root(), 'native', '_zkwire_ext.v%d.%s.so'
+                        % (_EXT_ABI_VERSION, tag))
+
+
+def build_ext() -> str | None:
+    """Compile the extension if missing or stale; return path or None."""
+    import sysconfig
+    src, out = ext_source_path(), ext_path()
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + '.tmp.%d' % os.getpid()
+    cmd = ['gcc', '-O2', '-shared', '-fPIC',
+           '-I', sysconfig.get_paths()['include'], src, '-o', tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info('native ext build unavailable: %s', e)
+        return None
+    if r.returncode != 0:
+        log.warning('native ext build failed: %s', r.stderr.strip())
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+#: opcode -> body-layout enum shared with zkwire_ext.c (keep in sync
+#: with records._RESP_READERS / _EMPTY_RESPONSES).
+_EXT_LAYOUTS = {
+    'SET_WATCHES': 0, 'PING': 0, 'SYNC': 0, 'DELETE': 0,
+    'CLOSE_SESSION': 0, 'AUTH': 0,
+    'GET_CHILDREN': 1, 'GET_CHILDREN2': 2, 'CREATE': 3, 'GET_ACL': 4,
+    'GET_DATA': 5, 'EXISTS': 6, 'SET_DATA': 6, 'NOTIFICATION': 7,
+}
+
+
+def _bind_ext(path: str):
+    import importlib.machinery
+    import importlib.util
+
+    loader = importlib.machinery.ExtensionFileLoader('_zkwire_ext', path)
+    spec = importlib.util.spec_from_file_location(
+        '_zkwire_ext', path, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    if mod.abi_version() != _EXT_ABI_VERSION:
+        log.warning('zkwire_ext ABI mismatch')
+        return None
+
+    from ..protocol import records
+    from ..protocol.consts import (
+        ErrCode,
+        KeeperState,
+        NotificationType,
+        Perm,
+    )
+
+    mod.setup(
+        records.Stat, records.ACL, records.Id, Perm,
+        {int(e): e.name for e in ErrCode},
+        {int(t): t.name for t in NotificationType},
+        {int(s): s.name for s in KeeperState},
+        dict(_EXT_LAYOUTS),
+    )
+    return mod
+
+
+def _try_load_ext() -> None:
+    global _ext, _ext_load_failed
+    out, src = ext_path(), ext_source_path()
+    if not (os.path.exists(out) and os.path.exists(src)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return
+    try:
+        _ext = _bind_ext(out)
+    except (OSError, ImportError) as e:
+        log.warning('zkwire_ext load failed: %s', e)
+        _ext = None
+    if _ext is None:
+        _ext_load_failed = True
+
+
+def get_ext():
+    """The bound extension module, or None if unavailable (yet).
+    Non-blocking, same contract as :func:`get_lib`."""
+    global _ext_builder
+    if os.environ.get('ZKSTREAM_NO_NATIVE') == '1':
+        return None
+    with _lock:
+        if _ext is not None or _ext_load_failed:
+            return _ext
+        _try_load_ext()
+        if _ext is not None or _ext_load_failed:
+            return _ext
+        if _ext_builder is None or not _ext_builder.is_alive():
+            _ext_builder = threading.Thread(
+                target=build_ext, name='zkwire-ext-build', daemon=True)
+            _ext_builder.start()
+        return None
+
+
+def ensure_ext():
+    """Blocking variant for tests/tools: build synchronously and bind."""
+    if os.environ.get('ZKSTREAM_NO_NATIVE') == '1':
+        return None
+    if build_ext() is None:
+        return None
+    return get_ext()
+
+
 class NativeFrameScanner:
     """ctypes facade over zkwire_frame_scan for one connection.
 
