@@ -297,8 +297,17 @@ def bench_client_ops() -> None:
         modes.append('native')
     modes.append('ingest')
     results = {}
+    # Interleaved best-of-2 per mode: this image runs everything on one
+    # shared core, so a single sequential pass can swing +-30% on
+    # scheduling noise alone.
+    for _ in range(2):
+        for mode in modes:
+            r = asyncio.run(_client_ops_run(mode))
+            if (mode not in results
+                    or r['get']['ops_per_sec']
+                    > results[mode]['get']['ops_per_sec']):
+                results[mode] = r
     for mode in modes:
-        results[mode] = asyncio.run(_client_ops_run(mode))
         print('# client_ops %s' % json.dumps(results[mode]),
               file=sys.stderr)
     base = results['python']['get']['ops_per_sec']

@@ -218,6 +218,11 @@ static int decode_body(Cursor *c, PyObject *pkt, int layout) {
       if (!need(c, 4)) return -1;
       int32_t n = rd_i32(c);
       if (n < 0) n = 0;
+      /* the count is wire-controlled: every element needs >= 4 bytes
+       * (its length prefix), so bound it by the remaining body before
+       * allocating — a corrupt frame must fail as BAD_DECODE, not as a
+       * multi-GB PyList_New */
+      if (!need(c, 4 * (Py_ssize_t)n)) return -1;
       PyObject *lst = PyList_New(n);
       if (lst == NULL) return -1;
       for (int32_t i = 0; i < n; ++i) {
@@ -237,6 +242,9 @@ static int decode_body(Cursor *c, PyObject *pkt, int layout) {
       if (!need(c, 4)) return -1;
       int32_t n = rd_i32(c);
       if (n < 0) n = 0;
+      /* wire-controlled count: each ACL entry is >= 12 bytes (perms
+       * int + two length prefixes); bound before allocating */
+      if (!need(c, 12 * (Py_ssize_t)n)) return -1;
       PyObject *lst = PyList_New(n);
       if (lst == NULL) return -1;
       for (int32_t i = 0; i < n; ++i) {

@@ -163,6 +163,21 @@ def test_unmatched_xid_is_bad_decode():
     assert 'matches no request' in str(e2)
 
 
+def test_huge_child_count_is_bad_decode_not_alloc():
+    """A tiny frame claiming 2^31-1 children must fail as BAD_DECODE in
+    both implementations — the C path must bound the wire-controlled
+    count before allocating, not attempt a multi-GB list."""
+    for opcode, count_payload in [
+            ('GET_CHILDREN', struct.pack('>i', 0x7FFFFFFF)),
+            ('GET_ACL', struct.pack('>i', 0x7FFFFFFF))]:
+        body = struct.pack('>iqi', 1, 5, 0) + count_payload
+        wire = struct.pack('>i', len(body)) + body
+        replies = [{'xid': 1, 'opcode': opcode}]
+        py, (k1, e1), ext, (k2, e2) = decode_both(wire, replies)
+        assert k1 == k2 == 'err'
+        assert e1.code == e2.code == 'BAD_DECODE'
+
+
 def test_unknown_notification_type_is_bad_decode():
     body = struct.pack('>iqi', -1, 5, 0) + struct.pack('>ii', 99, 3) \
         + struct.pack('>i', 2) + b'/x'

@@ -50,11 +50,16 @@ class ZKRequest(EventEmitter):
         self.packet = packet
 
     def as_future(self) -> asyncio.Future:
-        """Adapt to an awaitable resolving to the reply packet."""
+        """Adapt to an awaitable resolving to the reply packet.
+
+        Plain ``on`` (not ``once``): reply/error fire at most once per
+        request by contract, the ``done()`` guards make a double-settle
+        harmless, and skipping the once-wrapper + removal scan matters
+        on the per-op hot path."""
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self.once('reply', lambda pkt: fut.done() or fut.set_result(pkt))
-        self.once('error', lambda err, *a: fut.done() or
-                  fut.set_exception(err))
+        self.on('reply', lambda pkt: fut.done() or fut.set_result(pkt))
+        self.on('error', lambda err, *a: fut.done() or
+                fut.set_exception(err))
         return fut
 
 
@@ -427,9 +432,17 @@ class ZKConnection(FSM):
     def process_reply(self, pkt: dict) -> None:
         """Route a reply to its pending request
         (reference: lib/connection-fsm.js:353-376)."""
-        req = self.reqs.get(pkt['xid'])
+        xid = pkt['xid']
+        if xid > 0:
+            # One reply settles a normal request; dropping it here
+            # (rather than via per-request cleanup listeners) keeps the
+            # map tight.  Reserved xids (PING/SET_WATCHES) stay: their
+            # handlers manage piggybacking and pop themselves.
+            req = self.reqs.pop(xid, None)
+        else:
+            req = self.reqs.get(xid)
         self.log.trace('server replied to xid %d err %s',
-                       pkt['xid'], pkt['err'])
+                       xid, pkt['err'])
         if req is None:
             return
         if pkt['err'] == 'OK':
@@ -446,12 +459,6 @@ class ZKConnection(FSM):
         req = ZKRequest(pkt)
         pkt['xid'] = self.next_xid()
         self.reqs[pkt['xid']] = req
-
-        def end_request(*args):
-            self.reqs.pop(pkt['xid'], None)
-        req.once('reply', end_request)
-        req.once('error', end_request)
-
         self.log.trace('sent request xid %d opcode %s',
                        pkt['xid'], pkt['opcode'])
         self._write(pkt)

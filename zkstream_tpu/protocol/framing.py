@@ -218,8 +218,20 @@ class PacketCodec:
         (A/B-tested in tests/test_native_ext.py)."""
         buf = self._decoder._buf
         buf += chunk
-        pkts, consumed, kind, msg = self._ext.decode_responses(
-            buf, self.xid_map, MAX_PACKET)
+        try:
+            pkts, consumed, kind, msg = self._ext.decode_responses(
+                buf, self.xid_map, MAX_PACKET)
+        except Exception as e:
+            # Parity with the scalar path: ANY decode-side exception
+            # (e.g. MemoryError) surfaces as connection-fatal
+            # BAD_DECODE, never as a raw exception the connection FSM
+            # would not catch.
+            err = ZKProtocolError('BAD_DECODE',
+                'Failed to decode Response: %s: %s'
+                % (type(e).__name__, e))
+            err.__cause__ = e
+            err.packets = []
+            raise err
         if consumed:
             del buf[:consumed]
         if kind is not None:

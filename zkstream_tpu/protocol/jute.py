@@ -25,6 +25,7 @@ import struct
 
 _INT = struct.Struct('>i')
 _LONG = struct.Struct('>q')
+_struct_error = struct.error
 
 INT32_MIN = -(1 << 31)
 INT32_MAX = (1 << 31) - 1
@@ -73,6 +74,15 @@ class JuteWriter:
         if not (INT64_MIN <= v <= INT64_MAX):
             raise JuteValueError('int64 out of range: %r' % (v,))
         self._buf += _LONG.pack(v)
+
+    def write_struct(self, st, *vals) -> None:
+        """Encode a run of fixed-width fields in one call — the write
+        twin of :meth:`JuteReader.read_struct` (``st`` is a precompiled
+        big-endian ``struct.Struct`` of concatenated ints/longs)."""
+        try:
+            self._buf += st.pack(*vals)
+        except _struct_error as e:
+            raise JuteValueError(str(e)) from None
 
     def write_buffer(self, v: bytes) -> None:
         # Empty buffers go on the wire with length -1

@@ -87,17 +87,8 @@ def read_stat(r: JuteReader) -> Stat:
 
 
 def write_stat(w: JuteWriter, s: Stat) -> None:
-    w.write_long(s.czxid)
-    w.write_long(s.mzxid)
-    w.write_long(s.ctime)
-    w.write_long(s.mtime)
-    w.write_int(s.version)
-    w.write_int(s.cversion)
-    w.write_int(s.aversion)
-    w.write_long(s.ephemeralOwner)
-    w.write_int(s.dataLength)
-    w.write_int(s.numChildren)
-    w.write_long(s.pzxid)
+    # one 68-byte pack; field order is the Stat tuple order
+    w.write_struct(_STAT_STRUCT, *s)
 
 
 def read_acl(r: JuteReader) -> list[ACL]:
@@ -424,10 +415,9 @@ _RESP_WRITERS = {
 def write_response(w: JuteWriter, pkt: dict) -> None:
     """Encode a reply (server direction): 16-byte header (xid, zxid, err)
     then the body if the error is OK and the opcode has one."""
-    w.write_int(pkt['xid'])
-    w.write_long(pkt['zxid'])
     err = pkt.get('err', 'OK')
-    w.write_int(int(ErrCode[err]))
+    w.write_struct(_REPLY_HDR_STRUCT, pkt['xid'], pkt['zxid'],
+                   int(ErrCode[err]))
     if err != 'OK':
         return
     opcode = pkt['opcode']

@@ -59,6 +59,13 @@ class EventEmitter:
         snapshot = self._listeners.get(event)
         if not snapshot:
             return False
+        if len(snapshot) == 1:
+            # Hot path ('packet' and friends have one listener): no
+            # snapshot copy, no membership scans.  Nothing can
+            # deregister the listener before it runs — there is no
+            # earlier listener in this emit to do so.
+            snapshot[0](*args)
+            return True
         for cb in list(snapshot):
             live = self._listeners.get(event)
             if live is None:

@@ -127,9 +127,19 @@ def get_lib() -> ctypes.CDLL | None:
             return _lib
         if _builder is None or not _builder.is_alive():
             _builder = threading.Thread(
-                target=build, name='zkwire-build', daemon=True)
+                target=_build_or_latch, name='zkwire-build', daemon=True)
             _builder.start()
         return None
+
+
+def _build_or_latch() -> None:
+    """Background-build the C-ABI library; a failed compile latches
+    ``_load_failed`` so later ``get_lib`` calls don't respawn gcc for
+    the life of the process."""
+    global _load_failed
+    if build() is None:
+        with _lock:
+            _load_failed = True
 
 
 def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
@@ -262,9 +272,19 @@ def get_ext():
             return _ext
         if _ext_builder is None or not _ext_builder.is_alive():
             _ext_builder = threading.Thread(
-                target=build_ext, name='zkwire-ext-build', daemon=True)
+                target=_build_ext_or_latch, name='zkwire-ext-build',
+                daemon=True)
             _ext_builder.start()
         return None
+
+
+def _build_ext_or_latch() -> None:
+    """Background-build the extension; latch failure like
+    :func:`_build_or_latch`."""
+    global _ext_load_failed
+    if build_ext() is None:
+        with _lock:
+            _ext_load_failed = True
 
 
 def ensure_ext():
