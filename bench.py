@@ -184,7 +184,12 @@ async def _client_ops_run(mode: str) -> dict:
     use_native = None
     if mode == 'ingest':
         from zkstream_tpu.io.ingest import FleetIngest
-        ingest = FleetIngest(body_mode='host', max_frames=16)
+        # bypass_bytes=0: this mode exists to measure the batched
+        # device pipeline end-to-end; the production small-tick
+        # crossover would route this workload through the scalar codec
+        # (which the python/native modes already measure).
+        ingest = FleetIngest(body_mode='host', max_frames=16,
+                             bypass_bytes=0)
     elif mode == 'native':
         use_native = True
     elif mode == 'python':
@@ -275,6 +280,7 @@ async def _client_ops_run(mode: str) -> dict:
             'total_ms': round(dt * 1000.0, 2)}
         if ingest is not None:
             out['ingest_ticks'] = ingest.ticks
+            out['ingest_scalar_ticks'] = ingest.ticks_scalar
             out['ingest_frames'] = ingest.frames_routed
     finally:
         await asyncio.gather(*[c.close() for c in clients])

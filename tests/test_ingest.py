@@ -95,22 +95,51 @@ async def test_ingest_semantics_match_scalar_drain():
     batched path demonstrably carried the traffic."""
     scalar = await _run_mode(None)
 
-    host_ing = FleetIngest(body_mode='host', max_frames=8, min_len=256)
+    host_ing = FleetIngest(body_mode='host', max_frames=8, min_len=256, bypass_bytes=0)
     host = await _run_mode(host_ing)
     assert host == scalar
     assert host_ing.ticks > 0 and host_ing.frames_routed > 0
 
-    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256,
+    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256, bypass_bytes=0,
                           max_data=128, max_path=64)
     dev = await _run_mode(dev_ing)
     assert dev == scalar
     assert dev_ing.ticks > 0 and dev_ing.frames_routed > 0
 
 
+async def test_ingest_small_tick_bypass():
+    """With the default crossover enabled, small ticks drain through
+    the scalar codec (no device dispatch) with identical semantics;
+    the device pipeline engages only past the byte threshold."""
+    ingest = FleetIngest(body_mode='host', max_frames=8)  # default bypass
+    assert ingest.bypass_bytes > 0
+    scalar = await _run_mode(None)
+    got = await _run_mode(ingest)
+    assert got == scalar
+    assert ingest.ticks_scalar > 0     # small ticks took the bypass
+    assert ingest.ticks == 0           # nothing crossed the threshold
+    assert ingest.frames_routed > 0    # and traffic was still counted
+
+    # force a tick over the threshold: every buffered byte beyond
+    # bypass_bytes must go through the device path
+    big = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=64)
+    srv = await ZKServer().start()
+    c = make_client(srv.port, ingest=big)
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/blob', b'z' * 300)
+        data, _stat = await c.get('/blob')   # 300B reply > 64B threshold
+        assert data == b'z' * 300
+        assert big.ticks > 0
+    finally:
+        await c.close()
+        await srv.stop()
+
+
 async def test_ingest_device_fallbacks():
     """Oversized data fields and list-shaped bodies take the scalar
     fallback inside the device body mode, transparently."""
-    ingest = FleetIngest(body_mode='device', max_frames=8,
+    ingest = FleetIngest(body_mode='device', max_frames=8, bypass_bytes=0,
                          max_data=8, max_path=8)  # force fallbacks
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
@@ -135,7 +164,7 @@ async def test_ingest_fleet_256_connections(event_loop):
     op correct, every watcher fires, all frames through the batched
     path."""
     B = 256
-    ingest = FleetIngest(body_mode='host', max_frames=8, min_len=256)
+    ingest = FleetIngest(body_mode='host', max_frames=8, min_len=256, bypass_bytes=0)
     srv = await ZKServer().start()
     clients = [make_client(srv.port, ingest=ingest) for _ in range(B)]
     try:
@@ -236,7 +265,7 @@ async def test_ingest_bad_length_parity(split_writes):
     segment with a good reply."""
     scalar = await _bad_length_scenario(None, split_writes)
     fleet = await _bad_length_scenario(
-        FleetIngest(body_mode='host', max_frames=8), split_writes)
+        FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0), split_writes)
     assert fleet == scalar
     assert scalar[1] == 'BAD_LENGTH'
     if split_writes:  # separate chunks: the good reply was delivered
@@ -287,7 +316,7 @@ async def test_ingest_corrupt_ustring_parity():
     assert scalar == ('raise', 'ZKProtocolError', 'BAD_DECODE')
     for mode in ('host', 'device'):
         got = await _corrupt_create_scenario(
-            FleetIngest(body_mode=mode, max_frames=8))
+            FleetIngest(body_mode=mode, max_frames=8, bypass_bytes=0))
         assert got == scalar, (mode, got)
 
 
@@ -295,7 +324,7 @@ async def test_ingest_host_placement():
     """Explicit placement='host' pins ticks to the CPU backend and
     serves traffic normally (the latency-aware fallback for tunneled
     accelerators whose dispatch RTT exceeds the tick budget)."""
-    ingest = FleetIngest(body_mode='host', max_frames=8,
+    ingest = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0,
                          placement='host')
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
@@ -315,7 +344,7 @@ async def test_ingest_host_placement():
 async def test_ingest_reticks_past_max_frames():
     """More complete frames buffered than max_frames in one tick are
     finished on follow-up ticks, none lost."""
-    ingest = FleetIngest(body_mode='host', max_frames=2)
+    ingest = FleetIngest(body_mode='host', max_frames=2, bypass_bytes=0)
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
     try:

@@ -86,6 +86,7 @@ class FleetIngest:
                  max_data: int = 256, max_path: int = 256,
                  min_len: int = 256, placement: str = 'auto',
                  latency_budget_ms: float = 5.0,
+                 bypass_bytes: int = 32768,
                  log: Logger | None = None):
         assert body_mode in ('host', 'device'), body_mode
         assert placement in ('auto', 'accelerator', 'host'), placement
@@ -94,6 +95,14 @@ class FleetIngest:
         self.max_data = max_data
         self.max_path = max_path
         self.min_len = min_len
+        #: Small-tick crossover: when a tick holds fewer than this many
+        #: buffered wire bytes in total, the batch dispatch + readback
+        #: costs more than it saves, so the tick drains each stream
+        #: through its connection's own scalar codec (C-accelerated
+        #: when built) instead — identical observable semantics, the
+        #: scalar path being the spec.  0 forces every tick onto the
+        #: device pipeline (tests, benchmarks).
+        self.bypass_bytes = bypass_bytes
         #: Where the tick's XLA program runs.  A tick is latency-bound
         #: (one dispatch + one readback inside the event loop), so
         #: 'auto' probes the default accelerator's dispatch->readback
@@ -109,8 +118,10 @@ class FleetIngest:
         #: id(conn) -> (conn, accumulator)
         self._slots: dict[int, tuple['ZKConnection', bytearray]] = {}
         self._scheduled = False
-        #: diagnostics for tests/benchmarks
+        #: diagnostics for tests/benchmarks (``ticks`` counts device
+        #: ticks; small ticks under ``bypass_bytes`` count separately)
         self.ticks = 0
+        self.ticks_scalar = 0
         self.frames_routed = 0
         self._fns: dict = {}
 
@@ -319,6 +330,14 @@ class FleetIngest:
                   if buf and conn.is_in_state('connected')]
         if not active:
             return
+        if self.bypass_bytes and sum(
+                len(buf) for _c, buf in active) < self.bypass_bytes:
+            self.ticks_scalar += 1
+            for conn, buf in active:
+                if id(conn) not in self._slots:  # torn down mid-tick
+                    continue
+                self._deliver_scalar(conn, buf)
+            return
         self.ticks += 1
         self._resolve_placement()
 
@@ -379,8 +398,14 @@ class FleetIngest:
         if retick:
             self._schedule()
 
-    def _deliver_fallback(self, conn: 'ZKConnection',
-                          buf: bytearray) -> None:
+    def _deliver_scalar(self, conn: 'ZKConnection', buf: bytearray,
+                        keep_stream: bool = True) -> None:
+        """Drain one stream through the connection's own codec and emit
+        the result — the scalar-parity delivery shared by the small-tick
+        bypass (``keep_stream=True``: partial-frame residue returns to
+        this slot's accumulator, traffic is counted) and the bad-frame
+        fallback (``keep_stream=False``: the error the codec raises is
+        the point; the stream is about to die)."""
         data, err, pkts = bytes(buf), None, []
         buf.clear()
         try:
@@ -388,7 +413,20 @@ class FleetIngest:
         except ZKProtocolError as e:
             pkts = getattr(e, 'packets', [])
             err = e
+        else:
+            if keep_stream:
+                resid = conn.codec.take_pending()
+                if resid:
+                    buf.extend(resid)
+        if keep_stream:
+            self.frames_routed += len(pkts)
+            if not pkts and err is None:
+                return
         conn.emit('ingestDeliver', pkts, err)
+
+    def _deliver_fallback(self, conn: 'ZKConnection',
+                          buf: bytearray) -> None:
+        self._deliver_scalar(conn, buf, keep_stream=False)
 
     # -- host packet assembly --
 
