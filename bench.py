@@ -27,7 +27,7 @@ import time
 
 import numpy as np
 
-B = 16384        # streams (connections) per tick
+B = 32768        # streams (connections) per tick
 FRAMES = 64      # frames per stream
 BODY = 84        # body bytes per frame -> 104-byte frames
 REPEATS = 30     # dispatches per timing round (x4 rounds, min taken)
