@@ -95,13 +95,14 @@ async def test_ingest_semantics_match_scalar_drain():
     batched path demonstrably carried the traffic."""
     scalar = await _run_mode(None)
 
-    host_ing = FleetIngest(body_mode='host', max_frames=8, min_len=256, bypass_bytes=0)
+    host_ing = FleetIngest(body_mode='host', max_frames=8, min_len=256,
+                           bypass_bytes=0)
     host = await _run_mode(host_ing)
     assert host == scalar
     assert host_ing.ticks > 0 and host_ing.frames_routed > 0
 
-    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256, bypass_bytes=0,
-                          max_data=128, max_path=64)
+    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256,
+                          bypass_bytes=0, max_data=128, max_path=64)
     dev = await _run_mode(dev_ing)
     assert dev == scalar
     assert dev_ing.ticks > 0 and dev_ing.frames_routed > 0
@@ -164,7 +165,8 @@ async def test_ingest_fleet_256_connections(event_loop):
     op correct, every watcher fires, all frames through the batched
     path."""
     B = 256
-    ingest = FleetIngest(body_mode='host', max_frames=8, min_len=256, bypass_bytes=0)
+    ingest = FleetIngest(body_mode='host', max_frames=8, min_len=256,
+                         bypass_bytes=0)
     srv = await ZKServer().start()
     clients = [make_client(srv.port, ingest=ingest) for _ in range(B)]
     try:
@@ -265,7 +267,8 @@ async def test_ingest_bad_length_parity(split_writes):
     segment with a good reply."""
     scalar = await _bad_length_scenario(None, split_writes)
     fleet = await _bad_length_scenario(
-        FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0), split_writes)
+        FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0),
+        split_writes)
     assert fleet == scalar
     assert scalar[1] == 'BAD_LENGTH'
     if split_writes:  # separate chunks: the good reply was delivered

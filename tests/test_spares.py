@@ -8,13 +8,11 @@ the post-failover connection IS the pre-failover spare, so no TCP dial
 happened for it.
 """
 
-import asyncio
-
 import pytest
 
 from helpers import wait_until
 from zkstream_tpu import Client, CreateFlag
-from zkstream_tpu.server import ZKEnsemble, ZKServer
+from zkstream_tpu.server import ZKEnsemble
 
 
 @pytest.fixture

@@ -56,7 +56,7 @@ from ..protocol.records import (
 from ..utils.logging import Logger
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
-    from .connection import ZKConnection
+    from .connection import ZKConnection  # noqa: quoted annotations
 
 def _next_pow2(n: int) -> int:
     p = 1

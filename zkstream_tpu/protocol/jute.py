@@ -168,8 +168,8 @@ class JuteReader:
         """Decode a run of fixed-width fields in one call.  ``st`` is a
         precompiled big-endian ``struct.Struct`` whose layout is a
         concatenation of jute ints/longs — semantically identical to
-        the per-field reads but one bounds check and one C call for the
-        whole run (the scalar decode hot path: see tools/profile_hotpath.py)."""
+        the per-field reads but one bounds check and one C call for
+        the whole run (the scalar decode hot path: see PROFILE.md)."""
         self._need(st.size)
         v = st.unpack_from(self._view, self._off)
         self._off += st.size
