@@ -53,15 +53,21 @@ static PyObject *g_stat_cls;    /* records.Stat */
 static PyObject *g_acl_cls;    /* records.ACL */
 static PyObject *g_id_cls;     /* records.Id */
 static PyObject *g_perm_cls;   /* consts.Perm (IntFlag) */
+static PyObject *g_create_flag_cls; /* consts.CreateFlag (IntFlag) */
 static PyObject *g_err_names;  /* dict int -> str (ErrCode names) */
 static PyObject *g_notif_types; /* dict int -> str */
 static PyObject *g_states;     /* dict int -> str (KeeperState names) */
 static PyObject *g_layouts;    /* dict opcode-str -> layout int */
+static PyObject *g_req_opcodes; /* dict int -> (name, req-layout int) */
+static PyObject *g_op_names;   /* dict int -> str: EVERY valid OpCode */
 
 /* interned key + special-opcode strings */
 static PyObject *s_xid, *s_zxid, *s_err, *s_opcode, *s_data, *s_stat,
-    *s_path, *s_children, *s_acl, *s_type, *s_state;
+    *s_path, *s_children, *s_acl, *s_type, *s_state, *s_watch,
+    *s_version, *s_relZxid, *s_events, *s_flags;
 static PyObject *s_notification, *s_ping, *s_auth, *s_set_watches, *s_ok;
+static PyObject *s_dataChanged, *s_createdOrDestroyed,
+    *s_childrenChanged;
 
 /* layout enum — the Python side builds g_layouts with these values */
 enum {
@@ -73,6 +79,17 @@ enum {
   LAYOUT_GET_DATA = 5,
   LAYOUT_STAT_ONLY = 6,
   LAYOUT_NOTIFICATION = 7,
+};
+
+/* request-body layouts (server direction) — g_req_opcodes values */
+enum {
+  RQ_EMPTY = 0,
+  RQ_PATH = 1,
+  RQ_PATH_WATCH = 2,
+  RQ_CREATE = 3,
+  RQ_DELETE = 4,
+  RQ_SET_DATA = 5,
+  RQ_SET_WATCHES = 6,
 };
 
 /* ---- byte readers (big-endian, bounds-checked) ---- */
@@ -181,6 +198,60 @@ static PyObject *rd_stat(Cursor *c) {
   return stat;
 }
 
+/* strict jute bool: one byte, 0 or 1 only (jute.read_bool). Returns
+ * -1 on error with c->err set. */
+static int rd_bool(Cursor *c) {
+  if (!need(c, 1)) return -1;
+  uint8_t v = c->p[c->off];
+  c->off += 1;
+  if (v > 1) {
+    snprintf(c->err, sizeof(c->err), "bad bool byte %d", v);
+    return -1;
+  }
+  return v;
+}
+
+/* length-prefixed ACL list (records.read_acl): [ACL(Perm, Id)].
+ * NULL on error (c->err or a pending exception). */
+static PyObject *rd_acl_list(Cursor *c) {
+  if (!need(c, 4)) return NULL;
+  int32_t n = rd_i32(c);
+  if (n < 0) n = 0;
+  /* wire-controlled count: each ACL entry is >= 12 bytes (perms int +
+   * two length prefixes); bound before allocating */
+  if (!need(c, 12 * (Py_ssize_t)n)) return NULL;
+  PyObject *lst = PyList_New(n);
+  if (lst == NULL) return NULL;
+  for (int32_t i = 0; i < n; ++i) {
+    if (!need(c, 4)) {
+      Py_DECREF(lst);
+      return NULL;
+    }
+    int32_t perms = rd_i32(c);
+    PyObject *scheme = rd_string(c);
+    PyObject *ident = scheme ? rd_string(c) : NULL;
+    PyObject *entry = NULL;
+    if (ident != NULL) {
+      PyObject *id_obj =
+          PyObject_CallFunction(g_id_cls, "OO", scheme, ident);
+      PyObject *perm_obj =
+          id_obj ? PyObject_CallFunction(g_perm_cls, "i", perms) : NULL;
+      if (perm_obj != NULL)
+        entry = PyObject_CallFunction(g_acl_cls, "OO", perm_obj, id_obj);
+      Py_XDECREF(perm_obj);
+      Py_XDECREF(id_obj);
+    }
+    Py_XDECREF(scheme);
+    Py_XDECREF(ident);
+    if (entry == NULL) {
+      Py_DECREF(lst);
+      return NULL;
+    }
+    PyList_SET_ITEM(lst, i, entry);
+  }
+  return lst;
+}
+
 /* dict[int] lookup helper; returns borrowed ref or NULL (no exception) */
 static PyObject *int_key_get(PyObject *dict, long long key) {
   PyObject *k = PyLong_FromLongLong(key);
@@ -239,44 +310,7 @@ static int decode_body(Cursor *c, PyObject *pkt, int layout) {
       return 0;
     }
     case LAYOUT_GET_ACL: {
-      if (!need(c, 4)) return -1;
-      int32_t n = rd_i32(c);
-      if (n < 0) n = 0;
-      /* wire-controlled count: each ACL entry is >= 12 bytes (perms
-       * int + two length prefixes); bound before allocating */
-      if (!need(c, 12 * (Py_ssize_t)n)) return -1;
-      PyObject *lst = PyList_New(n);
-      if (lst == NULL) return -1;
-      for (int32_t i = 0; i < n; ++i) {
-        if (!need(c, 4)) {
-          Py_DECREF(lst);
-          return -1;
-        }
-        int32_t perms = rd_i32(c);
-        PyObject *scheme = rd_string(c);
-        PyObject *ident = scheme ? rd_string(c) : NULL;
-        PyObject *entry = NULL;
-        if (ident != NULL) {
-          PyObject *id_obj =
-              PyObject_CallFunction(g_id_cls, "OO", scheme, ident);
-          PyObject *perm_obj =
-              id_obj ? PyObject_CallFunction(g_perm_cls, "i", perms)
-                     : NULL;
-          if (perm_obj != NULL)
-            entry = PyObject_CallFunction(g_acl_cls, "OO", perm_obj,
-                                          id_obj);
-          Py_XDECREF(perm_obj);
-          Py_XDECREF(id_obj);
-        }
-        Py_XDECREF(scheme);
-        Py_XDECREF(ident);
-        if (entry == NULL) {
-          Py_DECREF(lst);
-          return -1;
-        }
-        PyList_SET_ITEM(lst, i, entry);
-      }
-      if (set_steal(pkt, s_acl, lst) < 0) return -1;
+      if (set_steal(pkt, s_acl, rd_acl_list(c)) < 0) return -1;
       return set_steal(pkt, s_stat, rd_stat(c));
     }
     case LAYOUT_NOTIFICATION: {
@@ -375,14 +409,131 @@ fail:
   return NULL;
 }
 
+/* ---- one frame -> request dict (server direction) ---- */
+
+static int decode_req_body(Cursor *c, PyObject *pkt, int layout) {
+  switch (layout) {
+    case RQ_EMPTY:
+      return 0;
+    case RQ_PATH:
+      return set_steal(pkt, s_path, rd_string(c));
+    case RQ_PATH_WATCH: {
+      if (set_steal(pkt, s_path, rd_string(c)) < 0) return -1;
+      int w = rd_bool(c);
+      if (w < 0) return -1;
+      return PyDict_SetItem(pkt, s_watch, w ? Py_True : Py_False);
+    }
+    case RQ_CREATE: {
+      if (set_steal(pkt, s_path, rd_string(c)) < 0) return -1;
+      if (set_steal(pkt, s_data, rd_bytes(c)) < 0) return -1;
+      if (set_steal(pkt, s_acl, rd_acl_list(c)) < 0) return -1;
+      if (!need(c, 4)) return -1;
+      return set_steal(pkt, s_flags,
+                       PyObject_CallFunction(g_create_flag_cls, "i",
+                                             rd_i32(c)));
+    }
+    case RQ_DELETE: {
+      if (set_steal(pkt, s_path, rd_string(c)) < 0) return -1;
+      if (!need(c, 4)) return -1;
+      return set_steal(pkt, s_version, PyLong_FromLong(rd_i32(c)));
+    }
+    case RQ_SET_DATA: {
+      if (set_steal(pkt, s_path, rd_string(c)) < 0) return -1;
+      if (set_steal(pkt, s_data, rd_bytes(c)) < 0) return -1;
+      if (!need(c, 4)) return -1;
+      return set_steal(pkt, s_version, PyLong_FromLong(rd_i32(c)));
+    }
+    case RQ_SET_WATCHES: {
+      if (!need(c, 8)) return -1;
+      PyObject *rel = PyLong_FromLongLong(rd_i64(c));
+      if (set_steal(pkt, s_relZxid, rel) < 0) return -1;
+      PyObject *events = PyDict_New();
+      if (events == NULL) return -1;
+      PyObject *kinds[3] = {s_dataChanged, s_createdOrDestroyed,
+                            s_childrenChanged};
+      for (int k = 0; k < 3; ++k) {
+        if (!need(c, 4)) {
+          Py_DECREF(events);
+          return -1;
+        }
+        int32_t n = rd_i32(c);
+        if (n < 0) n = 0;
+        if (!need(c, 4 * (Py_ssize_t)n)) { /* wire-controlled count */
+          Py_DECREF(events);
+          return -1;
+        }
+        PyObject *lst = PyList_New(n);
+        if (lst == NULL) {
+          Py_DECREF(events);
+          return -1;
+        }
+        for (int32_t i = 0; i < n; ++i) {
+          PyObject *s = rd_string(c);
+          if (s == NULL) {
+            Py_DECREF(lst);
+            Py_DECREF(events);
+            return -1;
+          }
+          PyList_SET_ITEM(lst, i, s);
+        }
+        if (PyDict_SetItem(events, kinds[k], lst) < 0) {
+          Py_DECREF(lst);
+          Py_DECREF(events);
+          return -1;
+        }
+        Py_DECREF(lst);
+      }
+      return set_steal(pkt, s_events, events);
+    }
+    default:
+      snprintf(c->err, sizeof(c->err), "unknown request layout %d",
+               layout);
+      return -1;
+  }
+}
+
+static PyObject *decode_request(Cursor *c) {
+  if (!need(c, 8)) return NULL;
+  int32_t xid = rd_i32(c);
+  int32_t op = rd_i32(c);
+
+  PyObject *entry = int_key_get(g_req_opcodes, op);
+  if (entry == NULL) {
+    /* match the Python spec's two distinct failures: a protocol-valid
+     * opcode with no request reader vs a number outside the enum */
+    PyObject *known = int_key_get(g_op_names, op);
+    if (known != NULL)
+      snprintf(c->err, sizeof(c->err), "unsupported opcode '%s'",
+               PyUnicode_AsUTF8(known));
+    else
+      snprintf(c->err, sizeof(c->err), "%d is not a valid OpCode", op);
+    return NULL;
+  }
+  PyObject *name = PyTuple_GET_ITEM(entry, 0);   /* borrowed */
+  int layout = (int)PyLong_AsLong(PyTuple_GET_ITEM(entry, 1));
+
+  PyObject *pkt = PyDict_New();
+  if (pkt == NULL) return NULL;
+  if (set_steal(pkt, s_xid, PyLong_FromLong(xid)) < 0) goto fail;
+  if (PyDict_SetItem(pkt, s_opcode, name) < 0) goto fail;
+  if (decode_req_body(c, pkt, layout) < 0) goto fail;
+  return pkt;
+
+fail:
+  Py_DECREF(pkt);
+  return NULL;
+}
+
 /* ---- module functions ---- */
 
 static PyObject *py_setup(PyObject *self, PyObject *args) {
-  PyObject *stat_cls, *acl_cls, *id_cls, *perm_cls, *err_names,
-      *notif_types, *states, *layouts;
-  if (!PyArg_ParseTuple(args, "OOOOOOOO", &stat_cls, &acl_cls, &id_cls,
-                        &perm_cls, &err_names, &notif_types, &states,
-                        &layouts))
+  PyObject *stat_cls, *acl_cls, *id_cls, *perm_cls, *create_flag_cls,
+      *err_names, *notif_types, *states, *layouts, *req_opcodes,
+      *op_names;
+  if (!PyArg_ParseTuple(args, "OOOOOOOOOOO", &stat_cls, &acl_cls,
+                        &id_cls, &perm_cls, &create_flag_cls,
+                        &err_names, &notif_types, &states, &layouts,
+                        &req_opcodes, &op_names))
     return NULL;
   /* rd_stat builds instances through tuple's tp_new */
   if (!PyType_Check(stat_cls) ||
@@ -394,20 +545,23 @@ static PyObject *py_setup(PyObject *self, PyObject *args) {
   Py_INCREF(acl_cls); Py_XSETREF(g_acl_cls, acl_cls);
   Py_INCREF(id_cls); Py_XSETREF(g_id_cls, id_cls);
   Py_INCREF(perm_cls); Py_XSETREF(g_perm_cls, perm_cls);
+  Py_INCREF(create_flag_cls);
+  Py_XSETREF(g_create_flag_cls, create_flag_cls);
   Py_INCREF(err_names); Py_XSETREF(g_err_names, err_names);
   Py_INCREF(notif_types); Py_XSETREF(g_notif_types, notif_types);
   Py_INCREF(states); Py_XSETREF(g_states, states);
   Py_INCREF(layouts); Py_XSETREF(g_layouts, layouts);
+  Py_INCREF(req_opcodes); Py_XSETREF(g_req_opcodes, req_opcodes);
+  Py_INCREF(op_names); Py_XSETREF(g_op_names, op_names);
   Py_RETURN_NONE;
 }
 
-static PyObject *py_decode_responses(PyObject *self, PyObject *args) {
-  Py_buffer view;
-  PyObject *xid_map;
-  int max_packet;
-  if (!PyArg_ParseTuple(args, "y*O!i", &view, &PyDict_Type, &xid_map,
-                        &max_packet))
-    return NULL;
+/* shared frame walk: slice complete frames, decode each body via the
+ * reply (xid_map != NULL) or request decoder, with the PacketCodec
+ * error contract.  Consumes/releases `view`. */
+static PyObject *decode_stream(Py_buffer view, PyObject *xid_map,
+                               int max_packet) {
+  const char *what = xid_map != NULL ? "Response" : "Request";
   if (g_stat_cls == NULL) {
     PyBuffer_Release(&view);
     PyErr_SetString(PyExc_RuntimeError, "setup() not called");
@@ -456,7 +610,8 @@ static PyObject *py_decode_responses(PyObject *self, PyObject *args) {
                            ((uint32_t)buf[off + 2] << 8) |
                            (uint32_t)buf[off + 3]);
     Cursor c = {buf + off + 4, ln, 0, {0}};
-    PyObject *pkt = decode_reply(&c, xid_map);
+    PyObject *pkt = xid_map != NULL ? decode_reply(&c, xid_map)
+                                    : decode_request(&c);
     if (pkt == NULL) {
       if (PyErr_Occurred()) { /* real exception (OOM etc.) */
         Py_DECREF(pkts);
@@ -464,8 +619,8 @@ static PyObject *py_decode_responses(PyObject *self, PyObject *args) {
         return NULL;
       }
       err_kind = "BAD_DECODE";
-      snprintf(err_msg, sizeof(err_msg), "Failed to decode Response: %s",
-               c.err);
+      snprintf(err_msg, sizeof(err_msg), "Failed to decode %s: %s",
+               what, c.err);
       goto done;
     }
     if (PyList_Append(pkts, pkt) < 0) {
@@ -488,16 +643,36 @@ done:
   return ret;
 }
 
+static PyObject *py_decode_responses(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  PyObject *xid_map;
+  int max_packet;
+  if (!PyArg_ParseTuple(args, "y*O!i", &view, &PyDict_Type, &xid_map,
+                        &max_packet))
+    return NULL;
+  return decode_stream(view, xid_map, max_packet);
+}
+
+static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  int max_packet;
+  if (!PyArg_ParseTuple(args, "y*i", &view, &max_packet)) return NULL;
+  return decode_stream(view, NULL, max_packet);
+}
+
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(1);
+  return PyLong_FromLong(3);
 }
 
 static PyMethodDef methods[] = {
     {"setup", py_setup, METH_VARARGS,
-     "setup(Stat, ACL, Id, Perm, err_names, notif_types, states, "
-     "layouts)"},
+     "setup(Stat, ACL, Id, Perm, CreateFlag, err_names, notif_types, "
+     "states, layouts, req_opcodes, op_names)"},
     {"decode_responses", py_decode_responses, METH_VARARGS,
      "decode_responses(buf, xid_map, max_packet) -> "
+     "(pkts, consumed, err_kind, err_msg)"},
+    {"decode_requests", py_decode_requests, METH_VARARGS,
+     "decode_requests(buf, max_packet) -> "
      "(pkts, consumed, err_kind, err_msg)"},
     {"abi_version", py_abi_version, METH_NOARGS, "native ABI version"},
     {NULL, NULL, 0, NULL}};
@@ -518,10 +693,19 @@ PyMODINIT_FUNC PyInit__zkwire_ext(void) {
   s_acl = PyUnicode_InternFromString("acl");
   s_type = PyUnicode_InternFromString("type");
   s_state = PyUnicode_InternFromString("state");
+  s_watch = PyUnicode_InternFromString("watch");
+  s_version = PyUnicode_InternFromString("version");
+  s_relZxid = PyUnicode_InternFromString("relZxid");
+  s_events = PyUnicode_InternFromString("events");
+  s_flags = PyUnicode_InternFromString("flags");
   s_notification = PyUnicode_InternFromString("NOTIFICATION");
   s_ping = PyUnicode_InternFromString("PING");
   s_auth = PyUnicode_InternFromString("AUTH");
   s_set_watches = PyUnicode_InternFromString("SET_WATCHES");
   s_ok = PyUnicode_InternFromString("OK");
+  s_dataChanged = PyUnicode_InternFromString("dataChanged");
+  s_createdOrDestroyed =
+      PyUnicode_InternFromString("createdOrDestroyed");
+  s_childrenChanged = PyUnicode_InternFromString("childrenChanged");
   return PyModule_Create(&moduledef);
 }

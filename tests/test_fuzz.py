@@ -114,6 +114,35 @@ def test_ext_and_python_codecs_agree(junk, xids, data):
             return
 
 
+@settings(max_examples=120, deadline=None)
+@given(st.binary(max_size=500), st.data())
+def test_server_ext_and_python_codecs_agree(junk, data):
+    """Server direction (request decode): same A/B contract as the
+    client direction, over arbitrary junk and chunking."""
+    if native.ensure_ext() is None:  # pragma: no cover - no compiler
+        pytest.skip('native extension unavailable')
+    py = PacketCodec(server=True, use_native=False)
+    ext = PacketCodec(server=True, use_native=True)
+    for c in (py, ext):
+        c.handshaking = False
+    pos = 0
+    while pos < len(junk):
+        take = data.draw(st.integers(1, len(junk) - pos))
+        chunk = junk[pos:pos + take]
+        pos += take
+        outcomes = []
+        for c in (py, ext):
+            try:
+                outcomes.append(('ok', c.decode(chunk), None))
+            except ZKProtocolError as e:
+                outcomes.append(
+                    ('err', getattr(e, 'packets', []), e.code))
+        assert outcomes[0] == outcomes[1]
+        assert py._decoder.pending() == ext._decoder.pending()
+        if outcomes[0][0] == 'err':
+            return
+
+
 @settings(max_examples=200, deadline=None)
 @given(st.integers(-2**31, 2**31 - 1), st.integers(-2**63, 2**63 - 1),
        st.binary(max_size=64), st.text(max_size=32),

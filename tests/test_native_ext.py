@@ -224,6 +224,122 @@ def test_handshake_stays_on_python_path():
     assert results[0] == results[1]
 
 
+ALL_REQUESTS = [
+    {'xid': 1, 'opcode': 'GET_DATA', 'path': '/a', 'watch': True},
+    {'xid': 2, 'opcode': 'EXISTS', 'path': '/b', 'watch': False},
+    {'xid': 3, 'opcode': 'GET_CHILDREN2', 'path': '/', 'watch': False},
+    {'xid': 4, 'opcode': 'GET_CHILDREN', 'path': '/c', 'watch': True},
+    {'xid': 5, 'opcode': 'CREATE', 'path': '/n', 'data': b'xyz',
+     'acl': list(records.OPEN_ACL_UNSAFE), 'flags': 3},
+    {'xid': 6, 'opcode': 'DELETE', 'path': '/n', 'version': -1},
+    {'xid': 7, 'opcode': 'SET_DATA', 'path': '/a', 'data': b'',
+     'version': 4},
+    {'xid': 8, 'opcode': 'GET_ACL', 'path': '/a'},
+    {'xid': 9, 'opcode': 'SYNC', 'path': '/'},
+    {'xid': -8, 'opcode': 'SET_WATCHES', 'relZxid': 77, 'events': {
+        'dataChanged': ['/a', '/b'], 'createdOrDestroyed': [],
+        'childrenChanged': ['/c']}},
+    {'xid': -2, 'opcode': 'PING'},
+    {'xid': 10, 'opcode': 'CLOSE_SESSION'},
+]
+
+
+def encode_requests(requests) -> bytes:
+    enc = PacketCodec()        # client direction encodes requests
+    enc.handshaking = False
+    return b''.join(enc.encode(dict(p)) for p in requests)
+
+
+def server_decode_both(wire: bytes):
+    out = []
+    for use_native in (False, True):
+        c = PacketCodec(server=True, use_native=use_native)
+        c.handshaking = False
+        try:
+            res = ('ok', c.decode(wire), None)
+        except ZKProtocolError as e:
+            res = ('err', getattr(e, 'packets', []), e.code)
+        out.append((c, res))
+    (py, py_res), (ext, ext_res) = out
+    assert ext._ext is not None, 'extension did not engage'
+    return py, py_res, ext, ext_res
+
+
+def test_server_direction_all_opcodes_equivalent():
+    """The server-side request decoder (C) equals the Python spec over
+    every request opcode, including SET_WATCHES' three path lists and
+    CREATE's ACL + flags."""
+    wire = encode_requests(ALL_REQUESTS)
+    py, (k1, a, _), ext, (k2, b, _) = server_decode_both(wire)
+    assert k1 == k2 == 'ok'
+    assert a == b
+    assert len(a) == len(ALL_REQUESTS)
+    assert a[4]['flags'] == b[4]['flags'] == 3
+    assert b[9]['events']['dataChanged'] == ['/a', '/b']
+    # split feeds too
+    c = PacketCodec(server=True, use_native=True)
+    c.handshaking = False
+    got = []
+    for i in range(len(wire)):
+        got += c.decode(wire[i:i + 1])
+    assert got == b
+
+
+def test_layout_tables_stay_in_sync_with_spec():
+    """The C decoder's opcode->layout tables must cover exactly what
+    the Python spec decodes — a reader added to records.py without a
+    layout entry would make the C path reject what the spec accepts."""
+    from zkstream_tpu.protocol.records import (
+        _EMPTY_RESPONSES,
+        _REQ_READERS,
+        _RESP_READERS,
+    )
+    from zkstream_tpu.utils.native import _EXT_LAYOUTS, _EXT_REQ_LAYOUTS
+
+    assert set(_EXT_REQ_LAYOUTS) == set(_REQ_READERS)
+    assert set(_EXT_LAYOUTS) == \
+        set(_RESP_READERS) | set(_EMPTY_RESPONSES)
+
+
+def test_unsupported_vs_invalid_opcode_messages():
+    """Valid-but-unsupported opcodes (AUTH) and numbers outside the
+    enum produce the spec's two distinct messages from both paths."""
+    for op_num, expect in [(100, "unsupported opcode 'AUTH'"),
+                           (9999, '9999 is not a valid OpCode')]:
+        body = struct.pack('>ii', 1, op_num)
+        wire = struct.pack('>i', len(body)) + body
+        for use_native in (False, True):
+            c = PacketCodec(server=True, use_native=use_native)
+            c.handshaking = False
+            with pytest.raises(ZKProtocolError) as ei:
+                c.decode(wire)
+            assert ei.value.code == 'BAD_DECODE'
+            assert expect in str(ei.value), (use_native, str(ei.value))
+
+
+def test_server_direction_error_contracts():
+    # unknown opcode
+    body = struct.pack('>ii', 1, 9999)
+    wire = struct.pack('>i', len(body)) + body
+    py, (k1, p1, c1), ext, (k2, p2, c2) = server_decode_both(wire)
+    assert k1 == k2 == 'err'
+    assert c1 == c2 == 'BAD_DECODE'
+    # bad bool byte in a path+watch request
+    body = struct.pack('>ii', 1, 4) + struct.pack('>i', 2) + b'/a' \
+        + b'\x07'
+    wire = struct.pack('>i', len(body)) + body
+    py, (k1, p1, c1), ext, (k2, p2, c2) = server_decode_both(wire)
+    assert k1 == k2 == 'err'
+    assert c1 == c2 == 'BAD_DECODE'
+    # wire-controlled SET_WATCHES list count must not allocate
+    body = struct.pack('>ii', -8, 101) + struct.pack('>q', 0) \
+        + struct.pack('>i', 0x7FFFFFFF)
+    wire = struct.pack('>i', len(body)) + body
+    py, (k1, p1, c1), ext, (k2, p2, c2) = server_decode_both(wire)
+    assert k1 == k2 == 'err'
+    assert c1 == c2 == 'BAD_DECODE'
+
+
 def test_randomized_fleet_equivalence():
     rng = random.Random(1234)
     opcodes = ['GET_DATA', 'EXISTS', 'SET_DATA', 'CREATE', 'DELETE',

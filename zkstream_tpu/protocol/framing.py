@@ -134,12 +134,12 @@ class PacketCodec:
         #: xid -> opcode for replies in flight
         #: (reference: lib/zk-streams.js:145, connection-fsm.js:74).
         self.xid_map: dict[int, str] = {}
-        # The C-extension decoder covers the client receive direction
-        # (steady-state replies) — the profiled hot path; handshake and
-        # server-direction decode stay in Python.  Best-effort: absent
+        # The C-extension decoder covers both steady-state receive
+        # directions — replies (client) and requests (server); only the
+        # handshake exchange stays in Python.  Best-effort: absent
         # extension degrades to the scalar path.
         self._ext = None
-        if not server and use_native is not False:
+        if use_native is not False:
             from ..utils import native
             self._ext = (native.ensure_ext() if use_native
                          else native.get_ext())
@@ -219,16 +219,21 @@ class PacketCodec:
         buf = self._decoder._buf
         buf += chunk
         try:
-            pkts, consumed, kind, msg = self._ext.decode_responses(
-                buf, self.xid_map, MAX_PACKET)
+            if self._server:
+                pkts, consumed, kind, msg = self._ext.decode_requests(
+                    buf, MAX_PACKET)
+            else:
+                pkts, consumed, kind, msg = self._ext.decode_responses(
+                    buf, self.xid_map, MAX_PACKET)
         except Exception as e:
             # Parity with the scalar path: ANY decode-side exception
             # (e.g. MemoryError) surfaces as connection-fatal
             # BAD_DECODE, never as a raw exception the connection FSM
             # would not catch.
             err = ZKProtocolError('BAD_DECODE',
-                'Failed to decode Response: %s: %s'
-                % (type(e).__name__, e))
+                'Failed to decode %s: %s: %s'
+                % ('Request' if self._server else 'Response',
+                   type(e).__name__, e))
             err.__cause__ = e
             err.packets = []
             raise err

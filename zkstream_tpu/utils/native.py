@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 1
+_EXT_ABI_VERSION = 3
 
 _ext = None
 _ext_load_failed = False
@@ -202,13 +202,22 @@ def build_ext() -> str | None:
     return out
 
 
-#: opcode -> body-layout enum shared with zkwire_ext.c (keep in sync
-#: with records._RESP_READERS / _EMPTY_RESPONSES).
+#: opcode -> reply-body-layout enum shared with zkwire_ext.c (keep in
+#: sync with records._RESP_READERS / _EMPTY_RESPONSES).
 _EXT_LAYOUTS = {
     'SET_WATCHES': 0, 'PING': 0, 'SYNC': 0, 'DELETE': 0,
     'CLOSE_SESSION': 0, 'AUTH': 0,
     'GET_CHILDREN': 1, 'GET_CHILDREN2': 2, 'CREATE': 3, 'GET_ACL': 4,
     'GET_DATA': 5, 'EXISTS': 6, 'SET_DATA': 6, 'NOTIFICATION': 7,
+}
+
+#: opcode -> request-body-layout enum (keep in sync with
+#: records._REQ_READERS): 0 empty, 1 path, 2 path+watch, 3 create,
+#: 4 delete, 5 set_data, 6 set_watches.
+_EXT_REQ_LAYOUTS = {
+    'GET_CHILDREN': 2, 'GET_CHILDREN2': 2, 'GET_DATA': 2, 'EXISTS': 2,
+    'CREATE': 3, 'DELETE': 4, 'GET_ACL': 1, 'SET_DATA': 5, 'SYNC': 1,
+    'SET_WATCHES': 6, 'CLOSE_SESSION': 0, 'PING': 0,
 }
 
 
@@ -227,18 +236,23 @@ def _bind_ext(path: str):
 
     from ..protocol import records
     from ..protocol.consts import (
+        CreateFlag,
         ErrCode,
         KeeperState,
         NotificationType,
+        OpCode,
         Perm,
     )
 
     mod.setup(
-        records.Stat, records.ACL, records.Id, Perm,
+        records.Stat, records.ACL, records.Id, Perm, CreateFlag,
         {int(e): e.name for e in ErrCode},
         {int(t): t.name for t in NotificationType},
         {int(s): s.name for s in KeeperState},
         dict(_EXT_LAYOUTS),
+        {int(OpCode[name]): (name, layout)
+         for name, layout in _EXT_REQ_LAYOUTS.items()},
+        {int(o): o.name for o in OpCode},
     )
     return mod
 
