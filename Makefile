@@ -6,7 +6,7 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench clean
+.PHONY: all test check native bench asan clean
 
 all: check test
 
@@ -16,11 +16,18 @@ test: native
 check:
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)
 
-# Build the native host codec (native/zkwire.cpp -> libzkwire.v*.so).
-# Optional: the runtime degrades to pure Python without it.
+# Build the native host codecs (zkwire.cpp C-ABI scanner and the
+# zkwire_ext.c CPython-extension decoder).  Optional: the runtime
+# degrades to pure Python without them.
 native:
 	$(PYTHON) -c "from zkstream_tpu.utils import native; \
-	    p = native.build(); print(p or 'native build unavailable')"
+	    p = native.build(); print(p or 'native build unavailable'); \
+	    q = native.build_ext(); print(q or 'ext build unavailable')"
+
+# Memory-safety check: AddressSanitizer build of the extension driven
+# with valid corpora + a 20k-round mutation storm (tools/asan_check.py).
+asan:
+	$(PYTHON) tools/asan_check.py
 
 bench:
 	$(PYTHON) bench.py

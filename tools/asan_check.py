@@ -1,0 +1,137 @@
+"""Memory-safety check for the C-extension decoder (zkwire_ext.c).
+
+Builds the extension with AddressSanitizer and drives both decode
+directions with valid corpora plus a mutation storm (random
+truncations/bit flips/suffixes of valid wire), so every bounds check in
+the C code gets adversarial coverage.  Any out-of-bounds access aborts
+the process with an ASAN report.
+
+Must run as a child process with libasan preloaded; this script
+re-execs itself with LD_PRELOAD when needed.
+
+Usage:  python tools/asan_check.py  (or `make asan`)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = '/tmp/_zkwire_ext_asan.so'
+ROUNDS = int(os.environ.get('ASAN_ROUNDS', '20000'))
+
+
+def build() -> str | None:
+    import sysconfig
+    src = os.path.join(REPO, 'native', 'zkwire_ext.c')
+    cmd = ['gcc', '-O1', '-g', '-fsanitize=address', '-shared', '-fPIC',
+           '-I', sysconfig.get_paths()['include'], src, '-o', SO]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        print('build failed:\n%s' % r.stderr, file=sys.stderr)
+        return None
+    r = subprocess.run(['gcc', '-print-file-name=libasan.so'],
+                       capture_output=True, text=True)
+    return r.stdout.strip()
+
+
+def main() -> int:
+    if os.environ.get('_ASAN_CHILD') != '1':
+        libasan = build()
+        if not libasan or not os.path.exists(libasan):
+            print('asan unavailable; skipping', file=sys.stderr)
+            return 0
+        env = dict(os.environ, _ASAN_CHILD='1', LD_PRELOAD=libasan,
+                   ASAN_OPTIONS='detect_leaks=0:abort_on_error=1')
+        return subprocess.run([sys.executable, __file__],
+                              env=env).returncode
+
+    import importlib.machinery
+    import importlib.util
+    import random
+
+    loader = importlib.machinery.ExtensionFileLoader('_zkwire_ext', SO)
+    spec = importlib.util.spec_from_file_location(
+        '_zkwire_ext', SO, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+
+    sys.path.insert(0, REPO)
+    from zkstream_tpu.protocol import records
+    from zkstream_tpu.protocol.consts import (
+        CreateFlag,
+        ErrCode,
+        KeeperState,
+        NotificationType,
+        OpCode,
+        Perm,
+    )
+    from zkstream_tpu.protocol.framing import PacketCodec
+    from zkstream_tpu.utils.native import _EXT_LAYOUTS, _EXT_REQ_LAYOUTS
+
+    mod.setup(
+        records.Stat, records.ACL, records.Id, Perm, CreateFlag,
+        {int(e): e.name for e in ErrCode},
+        {int(t): t.name for t in NotificationType},
+        {int(s): s.name for s in KeeperState},
+        dict(_EXT_LAYOUTS),
+        {int(OpCode[n]): (n, l) for n, l in _EXT_REQ_LAYOUTS.items()},
+        {int(o): o.name for o in OpCode})
+
+    st = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, 3, 2, 8)
+    enc = PacketCodec(server=True, use_native=False)
+    enc.handshaking = False
+    wire = b''.join(enc.encode(p) for p in [
+        {'xid': 1, 'zxid': 1, 'opcode': 'GET_DATA', 'err': 'OK',
+         'data': b'abc', 'stat': st},
+        {'xid': 2, 'zxid': 2, 'opcode': 'GET_CHILDREN2', 'err': 'OK',
+         'children': ['x', 'y'], 'stat': st},
+        {'xid': 3, 'zxid': 3, 'opcode': 'GET_ACL', 'err': 'OK',
+         'acl': list(records.OPEN_ACL_UNSAFE), 'stat': st},
+        {'xid': -1, 'zxid': 4, 'opcode': 'NOTIFICATION', 'err': 'OK',
+         'type': 'CREATED', 'state': 'SYNC_CONNECTED', 'path': '/p'},
+    ])
+    cenc = PacketCodec(use_native=False)
+    cenc.handshaking = False
+    rwire = b''.join(cenc.encode(dict(p)) for p in [
+        {'xid': 1, 'opcode': 'CREATE', 'path': '/n', 'data': b'd',
+         'acl': list(records.OPEN_ACL_UNSAFE), 'flags': 1},
+        {'xid': -8, 'opcode': 'SET_WATCHES', 'relZxid': 9, 'events': {
+            'dataChanged': ['/a'], 'createdOrDestroyed': [],
+            'childrenChanged': []}},
+        {'xid': 2, 'opcode': 'SET_DATA', 'path': '/n',
+         'data': b'x' * 100, 'version': 2},
+    ])
+
+    xm = {i: 'GET_DATA' for i in range(1, 50)}
+    for _ in range(2000):
+        mod.decode_responses(wire, dict(xm), 16 << 20)
+        mod.decode_requests(rwire, 16 << 20)
+    print('valid corpora: OK')
+
+    rng = random.Random(7)
+    for _ in range(ROUNDS):
+        base = rng.choice((wire, rwire))
+        blob = bytearray(base[:rng.randrange(0, len(base) + 1)])
+        for _ in range(rng.randrange(0, 6)):
+            if blob:
+                blob[rng.randrange(len(blob))] = rng.randrange(256)
+        if rng.random() < 0.3:
+            blob += bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(0, 40)))
+        for call in (lambda b: mod.decode_responses(b, dict(xm),
+                                                    16 << 20),
+                     lambda b: mod.decode_requests(b, 16 << 20)):
+            try:
+                call(bytes(blob))
+            except Exception:
+                pass
+    print('mutation storm (%d rounds x 2 calls): no ASAN reports'
+          % ROUNDS)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
