@@ -1,0 +1,130 @@
+"""Chaos soak: sustained random ops under repeated connection murder.
+
+The reference's value is production resilience (session resumption,
+exactly-once request failure, watcher re-arm) — the targeted tests
+prove each mechanism in isolation; this proves them *composed*, under
+sustained fire, with the invariants that actually matter in a long-
+running process:
+
+- no unhandled exceptions reach the event loop (every teardown path
+  routes errors to its request/session owner);
+- every client ends the storm connected (or resumed) and usable;
+- no pending-request entry outlives the storm (fail-pending-
+  exactly-once really fails them all);
+- the process's task set returns to baseline (no leaked asyncio tasks).
+
+Bounded: ~8 s of chaos inside the 30 s per-test harness budget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from zkstream_tpu import Client
+from zkstream_tpu.protocol.errors import (
+    ZKNotConnectedError,
+    ZKPingTimeoutError,
+    ZKProtocolError,
+)
+from zkstream_tpu import ZKError
+from zkstream_tpu.server import ZKServer
+
+N_CLIENTS = 10
+CHAOS_SECONDS = 8.0
+
+#: Errors an op may legitimately surface while its connection is being
+#: murdered mid-flight.
+EXPECTED = (ZKError, ZKNotConnectedError, ZKProtocolError,
+            ZKPingTimeoutError, asyncio.TimeoutError)
+
+
+async def test_chaos_soak():
+    loop = asyncio.get_event_loop()
+    unhandled: list = []
+    loop.set_exception_handler(
+        lambda l, ctx: unhandled.append(ctx))
+
+    baseline_tasks = len(asyncio.all_tasks(loop))
+    srv = await ZKServer().start()
+    clients = [Client(address='127.0.0.1', port=srv.port,
+                      session_timeout=8000) for _ in range(N_CLIENTS)]
+    for c in clients:
+        c.start()
+    await asyncio.gather(*[c.wait_connected(timeout=10)
+                           for c in clients])
+
+    stats = {'ops': 0, 'errors': 0, 'kills': 0, 'watch_fires': 0}
+    stop = loop.time() + CHAOS_SECONDS
+
+    # a watcher per client on a shared path, firing throughout
+    for c in clients:
+        c.watcher('/shared').on(
+            'dataChanged', lambda *a: stats.__setitem__(
+                'watch_fires', stats['watch_fires'] + 1))
+    await clients[0].create('/shared', b'0')
+
+    async def worker(i: int, c: Client):
+        rng = random.Random(1000 + i)
+        seq = 0
+        while loop.time() < stop:
+            try:
+                op = rng.randrange(6)
+                if op == 0:
+                    seq += 1
+                    await c.create('/c%d-%d' % (i, seq), b'x')
+                elif op == 1:
+                    await c.set('/shared', b'v%d' % seq)
+                elif op == 2:
+                    await c.get('/shared')
+                elif op == 3:
+                    await c.list('/')
+                elif op == 4:
+                    await c.stat('/shared')
+                else:
+                    await c.delete('/c%d-%d' % (i, seq), -1)
+                stats['ops'] += 1
+            except EXPECTED:
+                stats['errors'] += 1
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(rng.uniform(0, 0.01))
+
+    async def chaos():
+        rng = random.Random(4242)
+        while loop.time() < stop:
+            await asyncio.sleep(rng.uniform(0.25, 0.6))
+            victim = rng.choice(clients)
+            sess = victim.session
+            conn = sess.get_connection() if sess else None
+            if conn is not None and conn.transport is not None:
+                conn.transport.abort()
+                stats['kills'] += 1
+
+    await asyncio.gather(chaos(),
+                         *[worker(i, c) for i, c in enumerate(clients)])
+
+    # -- invariants --
+    # every client converges back to usable within the session timeout
+    for c in clients:
+        await c.wait_connected(timeout=10)
+        data, _stat = await c.get('/shared')
+        assert data.startswith(b'v') or data == b'0'
+        conn = c.session.get_connection()
+        # no pending-request entry survived its connection's death:
+        # whatever is in-flight now belongs to the live connection only
+        for xid, req in list(conn.reqs.items()):
+            assert xid in conn.codec.xid_map or xid < 0
+
+    assert stats['kills'] >= 5, stats
+    assert stats['ops'] > 50, stats
+
+    await asyncio.gather(*[c.close() for c in clients])
+    await srv.stop()
+    await asyncio.sleep(0.2)  # let teardown callbacks drain
+
+    # the loop saw no unhandled exceptions through the whole storm
+    assert unhandled == [], unhandled[:3]
+    # no task leak: back to the baseline (the harness's own tasks)
+    leaked = [t for t in asyncio.all_tasks(loop)
+              if not t.done()]
+    assert len(leaked) <= baseline_tasks + 1, leaked
