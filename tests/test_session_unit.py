@@ -109,3 +109,25 @@ async def test_reattach_zero_session_id_reply_reverts():
     assert s.get_connection() is old
     assert s.session_id == 0x42
     s.close()
+
+
+async def test_expiry_timer_tracks_renegotiated_down_timeout():
+    """The lazy expiry timer must fire on the NEW (shorter) deadline
+    when the server renegotiates the session timeout down mid-life —
+    the pending long timer is rescheduled, not left to fire late."""
+    import asyncio
+
+    s = ZKSession(30000)               # client asks for 30 s
+    conn = StubConn()
+    s.attach_and_send_cr(conn)
+    # server grants only 600 ms
+    conn.emit('packet', {'sessionId': 0x42, 'timeOut': 600,
+                         'passwd': b'\x01' * 16})
+    assert s.is_in_state('attached')
+    assert s.get_timeout() == 600
+    # the pending timer must now be due within ~600 ms, not 30 s
+    assert s._expiry_at - time.monotonic() < 1.0
+    expired = asyncio.get_event_loop().create_future()
+    s.expiry_timer.on('timeout',
+                      lambda: expired.done() or expired.set_result(1))
+    await asyncio.wait_for(expired, 3)   # would hang if timer sat at 30 s

@@ -50,6 +50,8 @@ class ZKSession(FSM):
         self.last_pkt: float | None = None
         self.expiry_timer = EventEmitter()
         self._expiry_handle: asyncio.TimerHandle | None = None
+        self._expiry_deadline = 0.0
+        self._expiry_at = 0.0      # when the pending handle will fire
         self.watchers: dict[str, ZKWatcher] = {}
         self.timeout = timeout
         self.last_attach = 0.0
@@ -93,15 +95,38 @@ class ZKSession(FSM):
         self.emit('assertAttach', conn)
 
     def reset_expiry_timer(self) -> None:
-        self.last_pkt = time.monotonic() * 1000.0
-        if self._expiry_handle is not None:
+        """Push the expiry deadline out by one session timeout.
+
+        Called on every received packet, so it must be cheap: the
+        deadline is just a number, and ONE lazy timer chases it — when
+        the timer fires early (deadline moved while it slept) it
+        reschedules for the remainder instead of expiring.  Avoids a
+        cancel + heap insertion per packet (this showed up in the e2e
+        runtime profile)."""
+        now = time.monotonic()
+        self.last_pkt = now * 1000.0
+        self._expiry_deadline = now + self.timeout / 1000.0
+        if self._expiry_handle is None:
+            self._schedule_expiry(self.timeout / 1000.0)
+        elif self._expiry_deadline < self._expiry_at:
+            # The deadline moved EARLIER (server renegotiated the
+            # session timeout down on reattach) — the lazy timer must
+            # not fire late, so this rare case does reschedule.
             self._expiry_handle.cancel()
+            self._schedule_expiry(self.timeout / 1000.0)
+
+    def _schedule_expiry(self, delay: float) -> None:
         loop = asyncio.get_event_loop()
 
         def fire():
             self._expiry_handle = None
-            self.expiry_timer.emit('timeout')
-        self._expiry_handle = loop.call_later(self.timeout / 1000.0, fire)
+            remaining = self._expiry_deadline - time.monotonic()
+            if remaining > 0:          # deadline moved while sleeping
+                self._schedule_expiry(remaining)
+            else:
+                self.expiry_timer.emit('timeout')
+        self._expiry_at = time.monotonic() + delay
+        self._expiry_handle = loop.call_later(delay, fire)
 
     def _cancel_expiry_timer(self) -> None:
         if self._expiry_handle is not None:
