@@ -21,14 +21,13 @@ from __future__ import annotations
 import asyncio
 import random
 
-from zkstream_tpu import Client
+from zkstream_tpu import Client, CreateFlag, ZKError
 from zkstream_tpu.protocol.errors import (
     ZKNotConnectedError,
     ZKPingTimeoutError,
     ZKProtocolError,
 )
-from zkstream_tpu import ZKError
-from zkstream_tpu.server import ZKServer
+from zkstream_tpu.server import ZKEnsemble, ZKServer
 
 N_CLIENTS = 10
 CHAOS_SECONDS = 8.0
@@ -127,4 +126,85 @@ async def test_chaos_soak():
     # no task leak: back to the baseline (the harness's own tasks)
     leaked = [t for t in asyncio.all_tasks(loop)
               if not t.done()]
+    assert len(leaked) <= baseline_tasks + 1, leaked
+
+
+async def test_chaos_soak_ensemble():
+    """The failover composition under fire: clients spread over a
+    3-member ensemble while backends are killed and restarted (never
+    all at once). Sessions must migrate/resume, an ephemeral node must
+    survive every kill its owner outlives, and the same global
+    invariants hold (no unhandled loop exceptions, no task leak)."""
+    loop = asyncio.get_event_loop()
+    unhandled: list = []
+    loop.set_exception_handler(lambda l, ctx: unhandled.append(ctx))
+    baseline_tasks = len(asyncio.all_tasks(loop))
+
+    ens = await ZKEnsemble(3).start()
+    clients = [Client(servers=ens.addresses(), session_timeout=8000)
+               for _ in range(6)]
+    for c in clients:
+        c.start()
+    await asyncio.gather(*[c.wait_connected(timeout=10)
+                           for c in clients])
+
+    # an ephemeral node owned by clients[0] must ride out every kill
+    await clients[0].create('/eph', b'mine', flags=CreateFlag.EPHEMERAL)
+
+    stats = {'ops': 0, 'errors': 0, 'kills': 0}
+    stop = loop.time() + CHAOS_SECONDS
+
+    async def worker(i: int, c: Client):
+        rng = random.Random(2000 + i)
+        seq = 0
+        while loop.time() < stop:
+            try:
+                op = rng.randrange(4)
+                if op == 0:
+                    seq += 1
+                    await c.create('/e%d-%d' % (i, seq), b'x')
+                elif op == 1:
+                    await c.stat('/eph')
+                elif op == 2:
+                    await c.list('/')
+                else:
+                    await c.get('/eph')
+                stats['ops'] += 1
+            except EXPECTED:
+                stats['errors'] += 1
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(rng.uniform(0, 0.01))
+
+    async def chaos():
+        rng = random.Random(777)
+        down: int | None = None
+        while loop.time() < stop:
+            await asyncio.sleep(rng.uniform(0.8, 1.4))
+            if down is not None:
+                await ens.restart(down)
+                down = None
+                continue
+            down = rng.randrange(3)
+            await ens.kill(down)
+            stats['kills'] += 1
+        if down is not None:
+            await ens.restart(down)
+
+    await asyncio.gather(chaos(),
+                         *[worker(i, c) for i, c in enumerate(clients)])
+
+    for c in clients:
+        await c.wait_connected(timeout=10)
+    # the ephemeral's owner never expired, so the node must still exist
+    data, _stat = await clients[1].get('/eph')
+    assert data == b'mine'
+    assert stats['kills'] >= 2, stats
+    assert stats['ops'] > 30, stats
+
+    await asyncio.gather(*[c.close() for c in clients])
+    await ens.stop()
+    await asyncio.sleep(0.2)
+
+    assert unhandled == [], unhandled[:3]
+    leaked = [t for t in asyncio.all_tasks(loop) if not t.done()]
     assert len(leaked) <= baseline_tasks + 1, leaked

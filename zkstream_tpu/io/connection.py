@@ -388,8 +388,18 @@ class ZKConnection(FSM):
         self.log.warning('error communicating with ZK: %s',
                          self.last_error)
         reqs, self.reqs = self.reqs, {}
+        # Pending ops surface the ZK error taxonomy, never a raw OS
+        # exception: a socket-level error becomes CONNECTION_LOSS with
+        # the original chained as __cause__ (the clean-close straggler
+        # path already spoke ZKProtocolError only).
+        req_err = self.last_error
+        if not isinstance(req_err, (ZKProtocolError, ZKError)):
+            wrapped = ZKProtocolError(
+                'CONNECTION_LOSS', 'Connection lost: %s' % (req_err,))
+            wrapped.__cause__ = req_err
+            req_err = wrapped
         for req in reqs.values():
-            req.emit('error', self.last_error)
+            req.emit('error', req_err)
 
         # Deliberately not scope-bound: the 'error' event must fire even
         # though we leave this state immediately
