@@ -35,13 +35,13 @@ REPEATS = 30     # dispatches per timing round (x4 rounds, min taken)
 
 def _fleet():
     """Vectorized fleet builder: [B, L] framed reply streams with
-    random xids/zxids/bodies (16384 x 64 x 104 B = 104 MiB at the
+    random xids/zxids/bodies (32768 x 64 x 104 B = 208 MiB at the
     default shape).  A shape sweep on the tunneled v5e showed the step
     time pinned at ~90-140 us from 13 MiB up to 208 MiB per tick — the
     remote-dispatch latency floor — so the tick must be fleet-proxy
     sized for the device to be doing meaningful work per dispatch; at
-    104 MiB/tick the decode sustains ~0.9 TiB/s vs ~0.1 TiB/s at the
-    round-1 2048x64 shape."""
+    208 MiB/tick the decode sustains ~1.7-2.9 TiB/s vs ~0.1 TiB/s at
+    the round-1 2048x64 shape."""
     rng = np.random.RandomState(42)
     frame_len = 4 + 16 + BODY
     L = FRAMES * frame_len
@@ -211,9 +211,20 @@ async def _client_ops_run(mode: str) -> dict:
 
         # Warm the path before timing: connection steady state, and —
         # for the ingest — the jit cache across the padded batch-size
-        # buckets the tick loop will hit.
+        # buckets the tick loop will hit.  Tolerant of a transient
+        # disconnect (a client mid-resume raises ZKNotConnectedError;
+        # on this single shared core a scheduling blip can trip one).
+        from zkstream_tpu.protocol.errors import ZKNotConnectedError
+
+        async def warm(c):
+            for _attempt in range(3):
+                try:
+                    return await c.get('/b')
+                except ZKNotConnectedError:
+                    await c.wait_connected(timeout=30)
+            print('# warm-up client never reconnected', file=sys.stderr)
         for _ in range(5):
-            await asyncio.gather(*[c.get('/b') for c in clients])
+            await asyncio.gather(*[warm(c) for c in clients])
 
         async def timed(coro_fn, n):
             lat = []
@@ -308,22 +319,33 @@ def bench_client_ops() -> None:
     # scheduling noise alone.
     for _ in range(2):
         for mode in modes:
-            r = asyncio.run(_client_ops_run(mode))
+            try:
+                r = asyncio.run(_client_ops_run(mode))
+            except Exception as e:
+                # a failed round must not kill the already-printed
+                # headline metric; the other round still reports
+                print('# client_ops %s round failed: %r' % (mode, e),
+                      file=sys.stderr)
+                continue
             if (mode not in results
                     or r['get']['ops_per_sec']
                     > results[mode]['get']['ops_per_sec']):
                 results[mode] = r
     for mode in modes:
-        print('# client_ops %s' % json.dumps(results[mode]),
-              file=sys.stderr)
-    base = results['python']['get']['ops_per_sec']
-    best_mode = max(results, key=lambda m: results[m]['get']['ops_per_sec'])
+        if mode in results:
+            print('# client_ops %s' % json.dumps(results[mode]),
+                  file=sys.stderr)
+    if not results:
+        return
+    base = results.get('python', {}).get('get', {}).get('ops_per_sec')
+    best_mode = max(results,
+                    key=lambda m: results[m]['get']['ops_per_sec'])
+    best = results[best_mode]['get']['ops_per_sec']
     print(json.dumps({
         'metric': 'client_get_ops_per_sec',
-        'value': results[best_mode]['get']['ops_per_sec'],
+        'value': best,
         'unit': 'ops/s',
-        'vs_baseline': round(
-            results[best_mode]['get']['ops_per_sec'] / base, 3),
+        'vs_baseline': round(best / base, 3) if base else None,
         'mode': best_mode,
     }), file=sys.stderr)
 
@@ -351,7 +373,10 @@ def main() -> None:
     }))
     print(f'# scalar baseline: {scalar:.2f} MiB/s over {B} streams x '
           f'{FRAMES} frames', file=sys.stderr)
-    bench_client_ops()
+    try:
+        bench_client_ops()
+    except Exception as e:  # secondary metrics never sink the run
+        print('# client_ops stage failed: %r' % (e,), file=sys.stderr)
 
 
 if __name__ == '__main__':
