@@ -195,7 +195,7 @@ async def _client_ops_run(mode: str) -> dict:
     elif mode == 'python':
         use_native = False
 
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     srv = await ZKServer().start()
     clients = [Client(address='127.0.0.1', port=srv.port,
                       session_timeout=30000, ingest=ingest,
@@ -222,7 +222,7 @@ async def _client_ops_run(mode: str) -> dict:
                     return await c.get('/b')
                 except ZKNotConnectedError:
                     await c.wait_connected(timeout=30)
-            print('# warm-up client never reconnected', file=sys.stderr)
+            return await c.get('/b')  # reconnected on the last wait
         for _ in range(5):
             await asyncio.gather(*[warm(c) for c in clients])
 

@@ -38,6 +38,7 @@ from .io.watcher import ZKWatcher
 from .protocol.consts import CreateFlag
 from .protocol.errors import ZKNotConnectedError
 from .protocol.records import OPEN_ACL_UNSAFE, Stat
+from .utils.aio import ambient_loop
 from .utils.fsm import FSM
 from .utils.logging import Logger
 from .utils.metrics import Collector
@@ -162,7 +163,7 @@ class Client(FSM):
         """Close the session cleanly and stop the pool."""
         if self.is_in_state('closed'):
             return
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         fut: asyncio.Future = loop.create_future()
         self.once('close', lambda: fut.done() or fut.set_result(None))
         self.emit('closeAsserted')
@@ -225,7 +226,7 @@ class Client(FSM):
         conn = self.current_connection()
         if conn is None:
             return
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         if conn.is_in_state('connected'):
             def fire():
                 self._event_track(evt)
@@ -244,7 +245,7 @@ class Client(FSM):
             def fire():
                 self._event_track('failed')
                 self.emit('failed', ZKNotConnectedError())
-            asyncio.get_event_loop().call_soon(fire)
+            ambient_loop().call_soon(fire)
 
     # -- connection access --
 
@@ -267,7 +268,7 @@ class Client(FSM):
             # 'failed' is edge-triggered; a pool already in monitor mode
             # will not re-emit it, so report the failure immediately.
             raise ZKNotConnectedError()
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         fut: asyncio.Future = loop.create_future()
 
         def on_connect():
@@ -318,7 +319,7 @@ class Client(FSM):
     async def ping(self) -> float:
         """Round-trip a ping; resolves to the latency in ms."""
         conn = self._conn_or_raise()
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         fut: asyncio.Future = loop.create_future()
 
         def cb(err, latency):

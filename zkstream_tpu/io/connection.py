@@ -56,7 +56,7 @@ class ZKRequest(EventEmitter):
         request by contract, the ``done()`` guards make a double-settle
         harmless, and skipping the once-wrapper + removal scan matters
         on the per-op hot path."""
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.on('reply', lambda pkt: fut.done() or fut.set_result(pkt))
         self.on('error', lambda err, *a: fut.done() or
                 fut.set_exception(err))
@@ -159,7 +159,7 @@ class ZKConnection(FSM):
         self.log.debug('attempting new connection')
 
         async def dial():
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             try:
                 await loop.create_connection(
                     lambda: _SocketProtocol(self),
@@ -169,7 +169,7 @@ class ZKConnection(FSM):
             except Exception as e:
                 self.emit('sockError', e)
 
-        self._dial_task = asyncio.get_event_loop().create_task(dial())
+        self._dial_task = asyncio.get_running_loop().create_task(dial())
 
         S.on(self, 'sockConnect', lambda: S.goto_state(
             'parked' if self.spare else 'handshaking'))
@@ -405,7 +405,7 @@ class ZKConnection(FSM):
         # though we leave this state immediately
         # (reference: lib/connection-fsm.js:317-323).
         err = self.last_error
-        asyncio.get_event_loop().call_soon(lambda: self.emit('error', err))
+        asyncio.get_running_loop().call_soon(lambda: self.emit('error', err))
 
         S.goto_state('closed')
 
@@ -496,7 +496,7 @@ class ZKConnection(FSM):
         req = ZKRequest(pkt)
         self.reqs[consts.XID_PING] = req
         timeout_ms = max(self.session.get_timeout() / 8, 2000)
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         t1 = time.monotonic()
 
         def on_reply(rpkt):

@@ -155,7 +155,7 @@ class FleetIngest:
     def _schedule(self) -> None:
         if not self._scheduled:
             self._scheduled = True
-            asyncio.get_event_loop().call_soon(self._tick)
+            asyncio.get_running_loop().call_soon(self._tick)
 
     # -- the per-tick batch --
 

@@ -30,6 +30,7 @@ import random
 from ..utils.events import EventEmitter
 from ..utils.logging import Logger
 from .connection import Backend, ZKConnection
+from ..utils.aio import ambient_loop
 
 
 @dataclasses.dataclass
@@ -105,7 +106,7 @@ class ConnectionPool(EventEmitter):
         assert self._task is None, 'pool already started'
         self._stopping = False
         self._set_state('starting')
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         self._task = loop.create_task(self._dial_loop())
         if self.max_spares > 0:
             self._spare_wake = asyncio.Event()
@@ -178,7 +179,7 @@ class ConnectionPool(EventEmitter):
         included); returns the connection on success, else destroys it
         and returns None.  Shared by dialing, spare parking, and spare
         promotion so the wait/cleanup/cancel handling cannot diverge."""
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         fut: asyncio.Future = loop.create_future()
 
         def settle(*args):
@@ -263,7 +264,7 @@ class ConnectionPool(EventEmitter):
 
     async def _hold_connection(self, idx: int, conn: ZKConnection) -> None:
         """Park while a connection (or a rebalance successor) is live."""
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         self._hold = loop.create_future()
         self._install_conn(idx, conn)
         self._set_state('running')
@@ -376,7 +377,7 @@ class ConnectionPool(EventEmitter):
 
     def _arm_decoherence(self) -> None:
         self._cancel_decoherence()
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
 
         def fire():
             if self._decoherence_task is None or \

@@ -116,7 +116,7 @@ class ZKSession(FSM):
             self._schedule_expiry(self.timeout / 1000.0)
 
     def _schedule_expiry(self, delay: float) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
 
         def fire():
             self._expiry_handle = None
@@ -166,7 +166,7 @@ class ZKSession(FSM):
         if self.fatal_handler is not None:
             self.fatal_handler(exc)
         else:
-            asyncio.get_event_loop().call_exception_handler({
+            asyncio.get_running_loop().call_exception_handler({
                 'message': 'zkstream fatal self-check failure '
                            '(crash-on-bug)',
                 'exception': exc,

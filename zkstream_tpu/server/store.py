@@ -26,6 +26,7 @@ import time
 from ..protocol.consts import CreateFlag
 from ..protocol.records import ACL, OPEN_ACL_UNSAFE, Stat
 from ..utils.events import EventEmitter
+from ..utils.aio import ambient_loop
 
 log = logging.getLogger('zkstream_tpu.server.store')
 
@@ -147,7 +148,7 @@ class ZKDatabase(EventEmitter):
         ensemble sees from it."""
         if sess.expiry_handle is not None:
             sess.expiry_handle.cancel()
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         sess.expiry_handle = loop.call_later(
             sess.timeout / 1000.0, lambda: self.expire_session(sess.id))
 

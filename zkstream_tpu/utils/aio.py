@@ -1,0 +1,25 @@
+"""Event-loop plumbing shared by the runtime components."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def ambient_loop() -> asyncio.AbstractEventLoop:
+    """The running loop, or — outside a running loop — the thread's set
+    loop.
+
+    ``Client.start()`` — and the client/pool FSM transitions it drives
+    synchronously — may legitimately run before the loop starts
+    spinning, queuing work the loop will process once entered;
+    ``asyncio.get_running_loop`` alone would forbid that pattern, while
+    bare ``get_event_loop`` is deprecated when no loop is set.  This
+    helper keeps both cases working and never creates an implicit loop
+    inside callbacks.  (Connections themselves are constructed only
+    inside pool tasks, so ``io/connection.py`` uses the stricter
+    ``get_running_loop`` throughout.)
+    """
+    try:
+        return asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.get_event_loop()

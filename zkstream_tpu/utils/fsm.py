@@ -24,6 +24,7 @@ import asyncio
 from typing import Callable
 
 from .events import EventEmitter
+from .aio import ambient_loop
 
 
 class StateScope:
@@ -46,14 +47,14 @@ class StateScope:
 
     def timeout(self, ms: float,
                 cb: Callable[[], None]) -> asyncio.TimerHandle:
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         handle = loop.call_later(ms / 1000.0,
                                  lambda: self._valid and cb())
         self._disposers.append(handle.cancel)
         return handle
 
     def interval(self, ms: float, cb: Callable[[], None]) -> None:
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         state = {}
 
         def fire():
@@ -67,7 +68,7 @@ class StateScope:
         self._disposers.append(lambda: state['h'].cancel())
 
     def immediate(self, cb: Callable[[], None]) -> None:
-        loop = asyncio.get_event_loop()
+        loop = ambient_loop()
         handle = loop.call_soon(lambda: self._valid and cb())
         self._disposers.append(handle.cancel)
 
