@@ -150,3 +150,18 @@ def test_cli_server_spec_parsing(capsys):
             cli.build_parser().parse_args(['-s', bad, 'ping'])
         assert ei.value.code == 2
         capsys.readouterr()
+
+
+async def test_cli_codec_flag(server, capsys):
+    """--codec native / python both serve a full get round trip; auto
+    is the default (parser-level)."""
+    for codec in ('native', 'python'):
+        rc, out, _ = await run_cli(server, '--codec', codec,
+                                   'create', '/k-%s' % codec, 'v',
+                                   capsys=capsys)
+        assert rc == 0
+        rc, out, _ = await run_cli(server, '--codec', codec,
+                                   'get', '/k-%s' % codec,
+                                   capsys=capsys)
+        assert rc == 0 and out == 'v\n'
+    assert cli.build_parser().parse_args(['ping']).codec == 'auto'

@@ -82,8 +82,11 @@ async def _run(args) -> int:
 
     addrs = ','.join('%s:%d' % (s['address'], s['port'])
                      for s in args.server)
+    use_native = {'auto': None, 'native': True,
+                  'python': False}[args.codec]
     client = Client(servers=args.server,
-                    session_timeout=args.session_timeout)
+                    session_timeout=args.session_timeout,
+                    use_native_codec=use_native)
     client.start()
     try:
         try:
@@ -216,6 +219,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help='ZK session timeout, ms')
     p.add_argument('--timeout', type=float, default=10.0,
                    help='connect timeout, seconds')
+    p.add_argument('--codec', choices=('auto', 'native', 'python'),
+                   default='auto',
+                   help='receive decoder: the C extension when built '
+                        '(native: require it; python: scalar codec; '
+                        'default auto)')
     sub = p.add_subparsers(dest='cmd', required=True)
 
     sub.add_parser('ping', help='round-trip a ping')
