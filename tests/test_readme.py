@@ -24,8 +24,7 @@ def test_readme_quickstart_runs():
     assert 'asyncio.run(main())' in snippet
     r = subprocess.run(
         [sys.executable, '-c', snippet], capture_output=True,
-        text=True, cwd=REPO, timeout=90,
-        env=dict(os.environ, ZKSTREAM_README_TEST='1'))
+        text=True, cwd=REPO, timeout=90)
     assert r.returncode == 0, (r.stdout, r.stderr)
     # the snippet registers a session listener that prints
     assert 'new session' in r.stdout, r.stdout

@@ -49,13 +49,18 @@ class ServerConnection:
 
     # -- wire helpers --
 
-    def _send(self, pkt: dict) -> None:
+    def _write_bytes(self, data: bytes) -> None:
         if self.closed:
             return
         try:
-            self.writer.write(self.codec.encode(pkt))
+            self.writer.write(data)
         except (ConnectionError, RuntimeError):
             pass
+
+    def _send(self, pkt: dict) -> None:
+        if self.closed:
+            return
+        self._write_bytes(self.codec.encode(pkt))
 
     def _reply(self, xid: int, opcode: str, err: str = 'OK',
                **body) -> None:
@@ -69,9 +74,23 @@ class ServerConnection:
         self._send(pkt)
 
     def notify(self, ntype: str, path: str) -> None:
-        self._send({'xid': XID_NOTIFICATION, 'zxid': self.db.zxid,
-                    'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
-                    'state': 'SYNC_CONNECTED', 'path': path})
+        """Send a watch notification; a fan-out (one db change, many
+        subscribed connections) encodes the identical packet ONCE and
+        shares the bytes — keyed by (type, path, zxid), which is unique
+        per change since zxid strictly increases per mutation."""
+        if self.closed:
+            return
+        key = (ntype, path, self.db.zxid)
+        cache = self.server._notif_cache
+        if cache is not None and cache[0] == key:
+            data = cache[1]
+        else:
+            data = self.codec.encode(
+                {'xid': XID_NOTIFICATION, 'zxid': self.db.zxid,
+                 'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
+                 'state': 'SYNC_CONNECTED', 'path': path})
+            self.server._notif_cache = (key, data)
+        self._write_bytes(data)
 
     # -- watch dispatch (db change events -> this connection) --
 
@@ -318,6 +337,9 @@ class ZKServer:
         #: in-flight requests to hang until teardown).
         self.drop_pings = False
         self.drop_replies = False
+        #: one-slot encode cache for notification fan-out
+        #: ((type, path, zxid), wire bytes)
+        self._notif_cache: tuple[tuple, bytes] | None = None
 
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(

@@ -16,9 +16,13 @@ from typing import Any, Callable
 class EventEmitter:
     def __init__(self) -> None:
         self._listeners: dict[str, list[Callable]] = {}
+        #: bumped on every registry mutation; lets emit() skip the
+        #: per-callback liveness checks when nothing changed mid-dispatch
+        self._ver = 0
 
     def on(self, event: str, cb: Callable) -> 'EventEmitter':
         self._listeners.setdefault(event, []).append(cb)
+        self._ver += 1
         return self
 
     def once(self, event: str, cb: Callable) -> 'EventEmitter':
@@ -27,6 +31,7 @@ class EventEmitter:
             cb(*args)
         wrapper.__wrapped__ = cb  # type: ignore[attr-defined]
         self._listeners.setdefault(event, []).append(wrapper)
+        self._ver += 1
         return self
 
     def remove_listener(self, event: str, cb: Callable) -> None:
@@ -36,6 +41,7 @@ class EventEmitter:
         for i, fn in enumerate(lst):
             if fn is cb or getattr(fn, '__wrapped__', None) is cb:
                 del lst[i]
+                self._ver += 1
                 break
         if not lst:
             self._listeners.pop(event, None)
@@ -45,6 +51,7 @@ class EventEmitter:
             self._listeners.clear()
         else:
             self._listeners.pop(event, None)
+        self._ver += 1
 
     def listeners(self, event: str) -> list[Callable]:
         return list(self._listeners.get(event, ()))
@@ -66,12 +73,18 @@ class EventEmitter:
             # earlier listener in this emit to do so.
             snapshot[0](*args)
             return True
+        # Multi-listener: liveness checks (O(n) each) are only needed
+        # for callbacks dispatched AFTER the registry mutated — a
+        # server db emitter carries 1 listener per subscribed
+        # connection, and O(n^2) per event would melt at fleet scale.
+        ver0 = self._ver
         for cb in list(snapshot):
-            live = self._listeners.get(event)
-            if live is None:
-                break
-            if cb not in live:
-                continue
+            if self._ver != ver0:
+                live = self._listeners.get(event)
+                if live is None:
+                    break
+                if cb not in live:
+                    continue
             cb(*args)
         return True
 
