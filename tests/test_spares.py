@@ -118,3 +118,36 @@ async def test_single_backend_spare_promotion(server):
         await c.ping()
     finally:
         await c.close()
+
+
+async def test_spare_promotion_with_ingest(ensemble):
+    """Spare promotion composes with the fleet ingest: the promoted
+    connection registers with the ingest and traffic keeps flowing
+    through the batched path (or its scalar bypass) after failover."""
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    ingest = FleetIngest(body_mode='host', max_frames=8)
+    c = make_client(ensemble, ingest=ingest)
+    try:
+        await c.wait_connected(timeout=5)
+        await wait_until(lambda: len(c.pool.spares) == 2, timeout=5)
+        await c.create('/i', b'before')
+        routed_before = ingest.frames_routed
+
+        spare_objs = list(c.pool.spares)
+        live_key = c.current_connection().backend.key
+        idx = next(i for i, s in enumerate(ensemble.servers)
+                   if ('%s:%d' % s.address) == live_key)
+        await ensemble.kill(idx)
+        await wait_until(
+            lambda: (c.is_connected()
+                     and c.current_connection() in spare_objs),
+            timeout=10)
+
+        data, _stat = await c.get('/i')
+        assert data == b'before'
+        # the promoted spare's replies went through the ingest
+        assert ingest.frames_routed > routed_before
+        assert id(c.current_connection()) in ingest._slots
+    finally:
+        await c.close()
