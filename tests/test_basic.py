@@ -141,6 +141,36 @@ async def test_large_payload_9kb(client):
     assert stat.dataLength == 9000
 
 
+async def test_megabyte_payload_all_codec_paths(server):
+    """A 1 MiB znode (ZooKeeper's jute.maxbuffer default) round-trips
+    through every receive path: scalar codec, C extension, and fleet
+    ingest — the frame spans many TCP segments, so this exercises
+    large-buffer reassembly in each."""
+    from zkstream_tpu import Client
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    payload = bytes(i % 251 for i in range(1 << 20))
+    configs = [
+        dict(use_native_codec=False),
+        dict(use_native_codec=None),       # ext when built
+        dict(ingest=FleetIngest(body_mode='host', max_frames=4,
+                                bypass_bytes=0)),
+    ]
+    for i, kw in enumerate(configs):
+        c = Client(address='127.0.0.1', port=server.port,
+                   session_timeout=10000, **kw)
+        c.start()
+        try:
+            await c.wait_connected(timeout=10)
+            path = '/mb%d' % i
+            await c.create(path, payload)
+            data, stat = await c.get(path)
+            assert data == payload
+            assert stat.dataLength == len(payload)
+        finally:
+            await c.close()
+
+
 async def test_ephemeral_and_sequential(client, server):
     path = await client.create(
         '/eseq', b'x', flags=CreateFlag.EPHEMERAL | CreateFlag.SEQUENTIAL)

@@ -169,3 +169,34 @@ async def test_notify_unmatched_escalates_fatally(client):
     await wait_until(lambda: bool(failures), timeout=5)
     assert isinstance(failures[0][0], LostWakeupError)
     assert sess.is_in_state('expired')
+
+
+async def test_doublecheck_probe_through_ingest(
+        fast_doublecheck, event_loop, server):
+    """The probe's EXISTS reply routes back through the fleet ingest's
+    batched delivery (bypass disabled so the device path carries it) —
+    the lost-wakeup self-check composes with the TPU data plane."""
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    ingest = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0)
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, ingest=ingest)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/dci', b'v0')
+        seen = []
+        c.watcher('/dci').on('dataChanged',
+                             lambda data, stat: seen.append(bytes(data)))
+        await wait_until(lambda: seen == [b'v0'])
+        we = c.watcher('/dci').watch_events['dataChanged']
+        states = []
+        we.on('stateChanged', lambda st: states.append(st))
+        await wait_until(lambda: 'armed.doublecheck' in states)
+        await wait_until(lambda: states[-1] == 'armed'
+                         and we.is_in_state('armed'))
+        await c.set('/dci', b'v1')
+        await wait_until(lambda: seen == [b'v0', b'v1'])
+        assert ingest.ticks > 0
+    finally:
+        await c.close()
