@@ -524,16 +524,356 @@ fail:
   return NULL;
 }
 
+/* ---- encode (steady state, both directions) ----------------------
+ *
+ * Best-effort accelerator with the Python JuteWriter as the semantic
+ * spec and fallback: any unexpected shape/type/range returns NULL
+ * WITHOUT setting an exception, and PacketCodec.encode re-runs the
+ * Python encoder, which raises its own precise validation errors.
+ * Byte-for-byte equality with the Python encoder is asserted in
+ * tests/test_native_ext.py. */
+
+typedef struct {
+  uint8_t *p;
+  Py_ssize_t len;
+  Py_ssize_t cap;
+  int oom;
+} WBuf;
+
+static int wb_reserve(WBuf *w, Py_ssize_t extra) {
+  if (w->len + extra <= w->cap) return 1;
+  Py_ssize_t ncap = w->cap ? w->cap * 2 : 256;
+  while (ncap < w->len + extra) ncap *= 2;
+  uint8_t *np = (uint8_t *)PyMem_Realloc(w->p, ncap);
+  if (np == NULL) {
+    w->oom = 1;
+    return 0;
+  }
+  w->p = np;
+  w->cap = ncap;
+  return 1;
+}
+
+static void wr_i32(WBuf *w, int32_t v) {
+  if (!wb_reserve(w, 4)) return;
+  w->p[w->len++] = (uint8_t)(v >> 24);
+  w->p[w->len++] = (uint8_t)(v >> 16);
+  w->p[w->len++] = (uint8_t)(v >> 8);
+  w->p[w->len++] = (uint8_t)v;
+}
+
+static void wr_i64(WBuf *w, int64_t v) {
+  if (!wb_reserve(w, 8)) return;
+  for (int i = 7; i >= 0; --i) w->p[w->len++] = (uint8_t)(v >> (8 * i));
+}
+
+/* fetch pkt[key] as int64 within [lo, hi]; 0 on any mismatch */
+static int get_i64(PyObject *pkt, PyObject *key, int64_t lo, int64_t hi,
+                   int64_t *out) {
+  PyObject *v = PyDict_GetItemWithError(pkt, key); /* borrowed */
+  if (v == NULL) {
+    PyErr_Clear();
+    return 0;
+  }
+  int overflow = 0;
+  long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+  if (overflow || (ll == -1 && PyErr_Occurred())) {
+    PyErr_Clear();
+    return 0;
+  }
+  if (ll < lo || ll > hi) return 0;
+  *out = ll;
+  return 1;
+}
+
+/* write an int-length-prefixed utf8 string from pkt[key]; empty
+ * encodes as itself (length 0 — matches write_ustring of "") */
+static int wr_str_field(WBuf *w, PyObject *pkt, PyObject *key) {
+  PyObject *v = PyDict_GetItemWithError(pkt, key);
+  if (v == NULL || !PyUnicode_Check(v)) {
+    PyErr_Clear();
+    return 0;
+  }
+  Py_ssize_t n;
+  const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+  if (s == NULL) {
+    PyErr_Clear();
+    return 0;
+  }
+  if (n > INT32_MAX) return 0;
+  /* JuteWriter.write_ustring encodes "" via write_buffer, which uses
+   * the -1 empty-buffer convention */
+  wr_i32(w, n == 0 ? -1 : (int32_t)n);
+  if (n && wb_reserve(w, n)) {
+    memcpy(w->p + w->len, s, n);
+    w->len += n;
+  }
+  return 1;
+}
+
+/* write an int-length-prefixed byte buffer from pkt[key]
+ * (empty -> length -1, lib/jute-buffer.js:127-130) */
+static int wr_bytes_field(WBuf *w, PyObject *pkt, PyObject *key) {
+  PyObject *v = PyDict_GetItemWithError(pkt, key);
+  if (v == NULL || !PyBytes_Check(v)) {
+    PyErr_Clear();
+    return 0;
+  }
+  Py_ssize_t n = PyBytes_GET_SIZE(v);
+  if (n > INT32_MAX) return 0;
+  wr_i32(w, n == 0 ? -1 : (int32_t)n);
+  if (n && wb_reserve(w, n)) {
+    memcpy(w->p + w->len, PyBytes_AS_STRING(v), n);
+    w->len += n;
+  }
+  return 1;
+}
+
+/* Stat from pkt[key] (an 11-tuple of ints, records.Stat) */
+static int wr_stat_field(WBuf *w, PyObject *pkt, PyObject *key) {
+  PyObject *v = PyDict_GetItemWithError(pkt, key);
+  if (v == NULL || !PyTuple_Check(v) || PyTuple_GET_SIZE(v) != 11) {
+    PyErr_Clear();
+    return 0;
+  }
+  static const int widths[11] = {8, 8, 8, 8, 4, 4, 4, 8, 4, 4, 8};
+  for (int i = 0; i < 11; ++i) {
+    PyObject *f = PyTuple_GET_ITEM(v, i);
+    int overflow = 0;
+    long long ll = PyLong_AsLongLongAndOverflow(f, &overflow);
+    if (overflow || (ll == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      return 0;
+    }
+    if (widths[i] == 4) {
+      if (ll < INT32_MIN || ll > INT32_MAX) return 0;
+      wr_i32(w, (int32_t)ll);
+    } else {
+      wr_i64(w, ll);
+    }
+  }
+  return 1;
+}
+
+/* name -> enum int via a reverse dict; -1 on miss */
+static int rev_lookup(PyObject *dict, PyObject *name, int64_t *out) {
+  PyObject *v = PyDict_GetItemWithError(dict, name);
+  if (v == NULL) {
+    PyErr_Clear();
+    return 0;
+  }
+  long long ll = PyLong_AsLongLong(v);
+  if (ll == -1 && PyErr_Occurred()) {
+    PyErr_Clear();
+    return 0;
+  }
+  *out = ll;
+  return 1;
+}
+
+static PyObject *g_err_codes;   /* dict str -> int (reverse ErrCode) */
+static PyObject *g_notif_codes; /* dict str -> int */
+static PyObject *g_state_codes; /* dict str -> int */
+static PyObject *g_op_codes;    /* dict str -> int (full OpCode) */
+
+/* response body by layout; 1 ok, 0 -> fall back to Python */
+static int enc_resp_body(WBuf *w, PyObject *pkt, int layout) {
+  switch (layout) {
+    case LAYOUT_EMPTY:
+      return 1;
+    case LAYOUT_CREATE:
+      return wr_str_field(w, pkt, s_path);
+    case LAYOUT_STAT_ONLY:
+      return wr_stat_field(w, pkt, s_stat);
+    case LAYOUT_GET_DATA:
+      return wr_bytes_field(w, pkt, s_data)
+             && wr_stat_field(w, pkt, s_stat);
+    case LAYOUT_GET_CHILDREN:
+    case LAYOUT_GET_CHILDREN2: {
+      PyObject *lst = PyDict_GetItemWithError(pkt, s_children);
+      if (lst == NULL || !PyList_Check(lst)) {
+        PyErr_Clear();
+        return 0;
+      }
+      Py_ssize_t n = PyList_GET_SIZE(lst);
+      if (n > INT32_MAX) return 0;
+      wr_i32(w, (int32_t)n);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject *sv = PyList_GET_ITEM(lst, i);
+        if (!PyUnicode_Check(sv)) return 0;
+        Py_ssize_t sn;
+        const char *s = PyUnicode_AsUTF8AndSize(sv, &sn);
+        if (s == NULL) {
+          PyErr_Clear();
+          return 0;
+        }
+        if (sn > INT32_MAX) return 0;
+        wr_i32(w, sn == 0 ? -1 : (int32_t)sn);
+        if (sn && wb_reserve(w, sn)) {
+          memcpy(w->p + w->len, s, sn);
+          w->len += sn;
+        }
+      }
+      if (layout == LAYOUT_GET_CHILDREN2)
+        return wr_stat_field(w, pkt, s_stat);
+      return 1;
+    }
+    case LAYOUT_NOTIFICATION: {
+      PyObject *t = PyDict_GetItemWithError(pkt, s_type);
+      PyObject *st = t ? PyDict_GetItemWithError(pkt, s_state) : NULL;
+      int64_t tv, sv;
+      if (st == NULL || !rev_lookup(g_notif_codes, t, &tv)
+          || !rev_lookup(g_state_codes, st, &sv)) {
+        PyErr_Clear();
+        return 0;
+      }
+      wr_i32(w, (int32_t)tv);
+      wr_i32(w, (int32_t)sv);
+      return wr_str_field(w, pkt, s_path);
+    }
+    default: /* GET_ACL responses are rare; Python handles them */
+      return 0;
+  }
+}
+
+/* request body by layout; 1 ok, 0 -> fall back */
+static int enc_req_body(WBuf *w, PyObject *pkt, int layout) {
+  switch (layout) {
+    case RQ_EMPTY:
+      return 1;
+    case RQ_PATH:
+      return wr_str_field(w, pkt, s_path);
+    case RQ_PATH_WATCH: {
+      if (!wr_str_field(w, pkt, s_path)) return 0;
+      PyObject *v = PyDict_GetItemWithError(pkt, s_watch);
+      if (v == NULL || !PyBool_Check(v)) {
+        PyErr_Clear();
+        return 0;
+      }
+      if (wb_reserve(w, 1)) w->p[w->len++] = v == Py_True ? 1 : 0;
+      return 1;
+    }
+    case RQ_DELETE: {
+      int64_t ver;
+      if (!wr_str_field(w, pkt, s_path)
+          || !get_i64(pkt, s_version, INT32_MIN, INT32_MAX, &ver))
+        return 0;
+      wr_i32(w, (int32_t)ver);
+      return 1;
+    }
+    case RQ_SET_DATA: {
+      int64_t ver;
+      if (!wr_str_field(w, pkt, s_path)
+          || !wr_bytes_field(w, pkt, s_data)
+          || !get_i64(pkt, s_version, INT32_MIN, INT32_MAX, &ver))
+        return 0;
+      wr_i32(w, (int32_t)ver);
+      return 1;
+    }
+    default: /* CREATE (acl+flags) and SET_WATCHES are rare; Python */
+      return 0;
+  }
+}
+
+/* shared: header + body + length prefix -> bytes (or NULL=fall back) */
+static PyObject *encode_framed(PyObject *pkt, int is_request) {
+  WBuf w = {NULL, 0, 0, 0};
+  wr_i32(&w, 0); /* length prefix slot */
+
+  int64_t xid;
+  if (!get_i64(pkt, s_xid, INT32_MIN, INT32_MAX, &xid)) goto fallback;
+  wr_i32(&w, (int32_t)xid);
+
+  PyObject *op = PyDict_GetItemWithError(pkt, s_opcode);
+  if (op == NULL || !PyUnicode_Check(op)) {
+    PyErr_Clear();
+    goto fallback;
+  }
+
+  if (is_request) {
+    int64_t opnum;
+    PyObject *entry;
+    if (!rev_lookup(g_op_codes, op, &opnum)) goto fallback;
+    wr_i32(&w, (int32_t)opnum);
+    /* layout via the request table (keyed by opcode number) */
+    entry = int_key_get(g_req_opcodes, opnum);
+    if (entry == NULL) goto fallback;
+    if (!enc_req_body(&w, pkt,
+                      (int)PyLong_AsLong(PyTuple_GET_ITEM(entry, 1))))
+      goto fallback;
+  } else {
+    int64_t zxid, errnum = 0;
+    if (!get_i64(pkt, s_zxid, INT64_MIN, INT64_MAX, &zxid))
+      goto fallback;
+    wr_i64(&w, zxid);
+    PyObject *err = PyDict_GetItemWithError(pkt, s_err);
+    if (err == NULL) { /* write_response defaults missing err to OK */
+      PyErr_Clear();
+    } else if (!rev_lookup(g_err_codes, err, &errnum)) {
+      goto fallback;
+    }
+    wr_i32(&w, (int32_t)errnum);
+    if (errnum == 0) {
+      PyObject *layout = PyDict_GetItemWithError(g_layouts, op);
+      if (layout == NULL) {
+        PyErr_Clear();
+        goto fallback;
+      }
+      if (!enc_resp_body(&w, pkt, (int)PyLong_AsLong(layout)))
+        goto fallback;
+    }
+  }
+
+  if (w.oom) goto fallback;
+  if (w.len - 4 > INT32_MAX) goto fallback; /* Python raises properly */
+  {
+    int32_t body_len = (int32_t)(w.len - 4);
+    w.p[0] = (uint8_t)(body_len >> 24);
+    w.p[1] = (uint8_t)(body_len >> 16);
+    w.p[2] = (uint8_t)(body_len >> 8);
+    w.p[3] = (uint8_t)body_len;
+    PyObject *out =
+        PyBytes_FromStringAndSize((const char *)w.p, w.len);
+    PyMem_Free(w.p);
+    return out; /* NULL here means real OOM; exception is set */
+  }
+
+fallback:
+  PyMem_Free(w.p);
+  Py_RETURN_NONE; /* sentinel: caller uses the Python encoder */
+}
+
+static PyObject *py_encode_request(PyObject *self, PyObject *args) {
+  PyObject *pkt;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &pkt)) return NULL;
+  if (g_op_codes == NULL) {
+    PyErr_SetString(PyExc_RuntimeError, "setup() not called");
+    return NULL;
+  }
+  return encode_framed(pkt, 1);
+}
+
+static PyObject *py_encode_response(PyObject *self, PyObject *args) {
+  PyObject *pkt;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &pkt)) return NULL;
+  if (g_op_codes == NULL) {
+    PyErr_SetString(PyExc_RuntimeError, "setup() not called");
+    return NULL;
+  }
+  return encode_framed(pkt, 0);
+}
+
 /* ---- module functions ---- */
 
 static PyObject *py_setup(PyObject *self, PyObject *args) {
   PyObject *stat_cls, *acl_cls, *id_cls, *perm_cls, *create_flag_cls,
       *err_names, *notif_types, *states, *layouts, *req_opcodes,
-      *op_names;
-  if (!PyArg_ParseTuple(args, "OOOOOOOOOOO", &stat_cls, &acl_cls,
+      *op_names, *err_codes, *notif_codes, *state_codes, *op_codes;
+  if (!PyArg_ParseTuple(args, "OOOOOOOOOOOOOOO", &stat_cls, &acl_cls,
                         &id_cls, &perm_cls, &create_flag_cls,
                         &err_names, &notif_types, &states, &layouts,
-                        &req_opcodes, &op_names))
+                        &req_opcodes, &op_names, &err_codes,
+                        &notif_codes, &state_codes, &op_codes))
     return NULL;
   /* rd_stat builds instances through tuple's tp_new */
   if (!PyType_Check(stat_cls) ||
@@ -553,6 +893,10 @@ static PyObject *py_setup(PyObject *self, PyObject *args) {
   Py_INCREF(layouts); Py_XSETREF(g_layouts, layouts);
   Py_INCREF(req_opcodes); Py_XSETREF(g_req_opcodes, req_opcodes);
   Py_INCREF(op_names); Py_XSETREF(g_op_names, op_names);
+  Py_INCREF(err_codes); Py_XSETREF(g_err_codes, err_codes);
+  Py_INCREF(notif_codes); Py_XSETREF(g_notif_codes, notif_codes);
+  Py_INCREF(state_codes); Py_XSETREF(g_state_codes, state_codes);
+  Py_INCREF(op_codes); Py_XSETREF(g_op_codes, op_codes);
   Py_RETURN_NONE;
 }
 
@@ -661,7 +1005,7 @@ static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
 }
 
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(3);
+  return PyLong_FromLong(4);
 }
 
 static PyMethodDef methods[] = {
@@ -674,6 +1018,10 @@ static PyMethodDef methods[] = {
     {"decode_requests", py_decode_requests, METH_VARARGS,
      "decode_requests(buf, max_packet) -> "
      "(pkts, consumed, err_kind, err_msg)"},
+    {"encode_request", py_encode_request, METH_VARARGS,
+     "encode_request(pkt) -> framed bytes, or None to fall back"},
+    {"encode_response", py_encode_response, METH_VARARGS,
+     "encode_response(pkt) -> framed bytes, or None to fall back"},
     {"abi_version", py_abi_version, METH_NOARGS, "native ABI version"},
     {NULL, NULL, 0, NULL}};
 

@@ -340,6 +340,38 @@ def test_server_direction_error_contracts():
     assert c1 == c2 == 'BAD_DECODE'
 
 
+def test_encode_equivalence_both_directions():
+    """The C encoders produce byte-identical frames to the Python
+    JuteWriter for every supported shape, and return None (Python
+    fallback) for the rare shapes they skip (CREATE requests with
+    ACLs, GET_ACL responses, SET_WATCHES) — so PacketCodec.encode is
+    byte-stable regardless of which side ran."""
+    ext = native.ensure_ext()
+    py = PacketCodec(use_native=False)
+    cx = PacketCodec(use_native=True)
+    py.handshaking = cx.handshaking = False
+    for p in ALL_REQUESTS:
+        assert py.encode(dict(p)) == cx.encode(dict(p)), p
+    assert py.xid_map == cx.xid_map
+    pys = PacketCodec(server=True, use_native=False)
+    cxs = PacketCodec(server=True, use_native=True)
+    pys.handshaking = cxs.handshaking = False
+    for p in ALL_REPLIES:
+        assert pys.encode(dict(p)) == cxs.encode(dict(p)), p
+    # fallback sentinel for shapes the C side declines
+    assert ext.encode_request(
+        {'xid': 1, 'opcode': 'SET_WATCHES', 'relZxid': 0,
+         'events': {}}) is None
+    assert ext.encode_response(
+        {'xid': 1, 'zxid': 1, 'opcode': 'GET_ACL', 'err': 'OK',
+         'acl': list(records.OPEN_ACL_UNSAFE),
+         'stat': STAT}) is None
+    # out-of-range fields also decline (Python raises the real error)
+    assert ext.encode_request(
+        {'xid': 1, 'opcode': 'DELETE', 'path': '/x',
+         'version': 1 << 40}) is None
+
+
 def test_randomized_fleet_equivalence():
     rng = random.Random(1234)
     opcodes = ['GET_DATA', 'EXISTS', 'SET_DATA', 'CREATE', 'DELETE',
@@ -384,6 +416,12 @@ def test_randomized_fleet_equivalence():
                     pkt['stat'] = st
             replies.append(pkt)
         wire = encode_replies(replies)
+        # the C response encoder must agree byte-for-byte wherever it
+        # engages (None = declined, Python produced the bytes)
+        cenc = PacketCodec(server=True, use_native=True)
+        cenc.handshaking = False
+        cwire = b''.join(cenc.encode(dict(p)) for p in replies)
+        assert cwire == wire
         py, (k1, a), ext, (k2, b) = decode_both(wire, replies)
         assert k1 == k2 == 'ok'
         assert a == b

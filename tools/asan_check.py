@@ -60,25 +60,10 @@ def main() -> int:
 
     sys.path.insert(0, REPO)
     from zkstream_tpu.protocol import records
-    from zkstream_tpu.protocol.consts import (
-        CreateFlag,
-        ErrCode,
-        KeeperState,
-        NotificationType,
-        OpCode,
-        Perm,
-    )
     from zkstream_tpu.protocol.framing import PacketCodec
-    from zkstream_tpu.utils.native import _EXT_LAYOUTS, _EXT_REQ_LAYOUTS
+    from zkstream_tpu.utils.native import ext_setup_args
 
-    mod.setup(
-        records.Stat, records.ACL, records.Id, Perm, CreateFlag,
-        {int(e): e.name for e in ErrCode},
-        {int(t): t.name for t in NotificationType},
-        {int(s): s.name for s in KeeperState},
-        dict(_EXT_LAYOUTS),
-        {int(OpCode[n]): (n, l) for n, l in _EXT_REQ_LAYOUTS.items()},
-        {int(o): o.name for o in OpCode})
+    mod.setup(*ext_setup_args())
 
     st = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, 3, 2, 8)
     enc = PacketCodec(server=True, use_native=False)
@@ -128,6 +113,26 @@ def main() -> int:
                 call(bytes(blob))
             except Exception:
                 pass
+    # encode paths: well-formed and near-miss dicts
+    enc_cases = [
+        {'xid': 1, 'opcode': 'GET_DATA', 'path': '/a', 'watch': True},
+        {'xid': 1, 'opcode': 'SET_DATA', 'path': '/a', 'data': b'x',
+         'version': 0},
+        {'xid': 1, 'opcode': 'GET_DATA', 'path': 42, 'watch': True},
+        {'xid': 'bad', 'opcode': 'PING'},
+    ]
+    for _ in range(5000):
+        for pkt in enc_cases:
+            try:
+                mod.encode_request(dict(pkt))
+            except Exception:
+                pass
+        try:
+            mod.encode_response({'xid': 1, 'zxid': 2, 'err': 'OK',
+                                 'opcode': 'GET_DATA', 'data': b'd',
+                                 'stat': records.Stat()})
+        except Exception:
+            pass
     print('mutation storm (%d rounds x 2 calls): no ASAN reports'
           % ROUNDS)
     return 0

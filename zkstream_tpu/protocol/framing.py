@@ -146,6 +146,17 @@ class PacketCodec:
 
     def encode(self, pkt: dict) -> bytes:
         """Encode one outgoing packet to framed wire bytes."""
+        if self._ext is not None and not self.handshaking:
+            # best-effort C encode: None means "shape the C side does
+            # not handle" (rare opcodes, out-of-range fields) — the
+            # Python encoder below is the spec and raises its own
+            # validation errors; byte equality is A/B-tested.
+            data = (self._ext.encode_response(pkt) if self._server
+                    else self._ext.encode_request(pkt))
+            if data is not None:
+                if not self._server:
+                    self.xid_map[pkt['xid']] = pkt['opcode']
+                return data
         w = JuteWriter()
         if self.handshaking:
             if self._server:

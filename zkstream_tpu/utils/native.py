@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 3
+_EXT_ABI_VERSION = 4
 
 _ext = None
 _ext_load_failed = False
@@ -221,6 +221,36 @@ _EXT_REQ_LAYOUTS = {
 }
 
 
+def ext_setup_args() -> tuple:
+    """The argument tuple for ``_zkwire_ext.setup`` — shared by the
+    loader and out-of-band harnesses (tools/asan_check.py) so a
+    signature change cannot leave them disagreeing."""
+    from ..protocol import records
+    from ..protocol.consts import (
+        CreateFlag,
+        ErrCode,
+        KeeperState,
+        NotificationType,
+        OpCode,
+        Perm,
+    )
+
+    return (
+        records.Stat, records.ACL, records.Id, Perm, CreateFlag,
+        {int(e): e.name for e in ErrCode},
+        {int(t): t.name for t in NotificationType},
+        {int(s): s.name for s in KeeperState},
+        dict(_EXT_LAYOUTS),
+        {int(OpCode[name]): (name, layout)
+         for name, layout in _EXT_REQ_LAYOUTS.items()},
+        {int(o): o.name for o in OpCode},
+        {e.name: int(e) for e in ErrCode},
+        {t.name: int(t) for t in NotificationType},
+        {s.name: int(s) for s in KeeperState},
+        {o.name: int(o) for o in OpCode},
+    )
+
+
 def _bind_ext(path: str):
     import importlib.machinery
     import importlib.util
@@ -233,27 +263,7 @@ def _bind_ext(path: str):
     if mod.abi_version() != _EXT_ABI_VERSION:
         log.warning('zkwire_ext ABI mismatch')
         return None
-
-    from ..protocol import records
-    from ..protocol.consts import (
-        CreateFlag,
-        ErrCode,
-        KeeperState,
-        NotificationType,
-        OpCode,
-        Perm,
-    )
-
-    mod.setup(
-        records.Stat, records.ACL, records.Id, Perm, CreateFlag,
-        {int(e): e.name for e in ErrCode},
-        {int(t): t.name for t in NotificationType},
-        {int(s): s.name for s in KeeperState},
-        dict(_EXT_LAYOUTS),
-        {int(OpCode[name]): (name, layout)
-         for name, layout in _EXT_REQ_LAYOUTS.items()},
-        {int(o): o.name for o in OpCode},
-    )
+    mod.setup(*ext_setup_args())
     return mod
 
 
