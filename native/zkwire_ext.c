@@ -68,6 +68,8 @@ static PyObject *s_xid, *s_zxid, *s_err, *s_opcode, *s_data, *s_stat,
 static PyObject *s_notification, *s_ping, *s_auth, *s_set_watches, *s_ok;
 static PyObject *s_dataChanged, *s_createdOrDestroyed,
     *s_childrenChanged;
+/* attribute names for ACL entries (records.ACL / records.Id) */
+static PyObject *s_perms, *s_scheme, *s_id_attr;
 
 /* layout enum — the Python side builds g_layouts with these values */
 enum {
@@ -586,14 +588,10 @@ static int get_i64(PyObject *pkt, PyObject *key, int64_t lo, int64_t hi,
   return 1;
 }
 
-/* write an int-length-prefixed utf8 string from pkt[key]; empty
- * encodes as itself (length 0 — matches write_ustring of "") */
-static int wr_str_field(WBuf *w, PyObject *pkt, PyObject *key) {
-  PyObject *v = PyDict_GetItemWithError(pkt, key);
-  if (v == NULL || !PyUnicode_Check(v)) {
-    PyErr_Clear();
-    return 0;
-  }
+/* write an int-length-prefixed utf8 string (the "" -> length -1
+ * empty-buffer convention of JuteWriter.write_ustring) */
+static int wr_str_obj(WBuf *w, PyObject *v) {
+  if (!PyUnicode_Check(v)) return 0;
   Py_ssize_t n;
   const char *s = PyUnicode_AsUTF8AndSize(v, &n);
   if (s == NULL) {
@@ -601,14 +599,21 @@ static int wr_str_field(WBuf *w, PyObject *pkt, PyObject *key) {
     return 0;
   }
   if (n > INT32_MAX) return 0;
-  /* JuteWriter.write_ustring encodes "" via write_buffer, which uses
-   * the -1 empty-buffer convention */
   wr_i32(w, n == 0 ? -1 : (int32_t)n);
   if (n && wb_reserve(w, n)) {
     memcpy(w->p + w->len, s, n);
     w->len += n;
   }
   return 1;
+}
+
+static int wr_str_field(WBuf *w, PyObject *pkt, PyObject *key) {
+  PyObject *v = PyDict_GetItemWithError(pkt, key);
+  if (v == NULL) {
+    PyErr_Clear();
+    return 0;
+  }
+  return wr_str_obj(w, v);
 }
 
 /* write an int-length-prefixed byte buffer from pkt[key]
@@ -699,20 +704,7 @@ static int enc_resp_body(WBuf *w, PyObject *pkt, int layout) {
       if (n > INT32_MAX) return 0;
       wr_i32(w, (int32_t)n);
       for (Py_ssize_t i = 0; i < n; ++i) {
-        PyObject *sv = PyList_GET_ITEM(lst, i);
-        if (!PyUnicode_Check(sv)) return 0;
-        Py_ssize_t sn;
-        const char *s = PyUnicode_AsUTF8AndSize(sv, &sn);
-        if (s == NULL) {
-          PyErr_Clear();
-          return 0;
-        }
-        if (sn > INT32_MAX) return 0;
-        wr_i32(w, sn == 0 ? -1 : (int32_t)sn);
-        if (sn && wb_reserve(w, sn)) {
-          memcpy(w->p + w->len, s, sn);
-          w->len += sn;
-        }
+        if (!wr_str_obj(w, PyList_GET_ITEM(lst, i))) return 0;
       }
       if (layout == LAYOUT_GET_CHILDREN2)
         return wr_stat_field(w, pkt, s_stat);
@@ -770,7 +762,83 @@ static int enc_req_body(WBuf *w, PyObject *pkt, int layout) {
       wr_i32(w, (int32_t)ver);
       return 1;
     }
-    default: /* CREATE (acl+flags) and SET_WATCHES are rare; Python */
+    case RQ_CREATE: {
+      /* path, data, ACL list (count; perms/scheme/id per entry —
+       * records.write_acl), flags (CreateFlag coerces; default 0) */
+      if (!wr_str_field(w, pkt, s_path)
+          || !wr_bytes_field(w, pkt, s_data))
+        return 0;
+      PyObject *acl = PyDict_GetItemWithError(pkt, s_acl);
+      if (acl == NULL || !(PyList_Check(acl) || PyTuple_Check(acl))) {
+        PyErr_Clear();
+        return 0;
+      }
+      Py_INCREF(acl); /* GetAttr below may run arbitrary Python that
+                       * drops the packet's reference */
+      Py_ssize_t n = PySequence_Fast_GET_SIZE(acl);
+      if (n > INT32_MAX) {
+        Py_DECREF(acl);
+        return 0;
+      }
+      wr_i32(w, (int32_t)n);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        /* a list can shrink under a hostile __getattr__ */
+        if (i >= PySequence_Fast_GET_SIZE(acl)) {
+          Py_DECREF(acl);
+          return 0;
+        }
+        PyObject *entry = PySequence_Fast_GET_ITEM(acl, i);
+        Py_INCREF(entry);
+        PyObject *perms = PyObject_GetAttr(entry, s_perms);
+        PyObject *idobj = perms ? PyObject_GetAttr(entry, s_id_attr)
+                                : NULL;
+        PyObject *scheme = idobj ? PyObject_GetAttr(idobj, s_scheme)
+                                 : NULL;
+        PyObject *ident = scheme ? PyObject_GetAttr(idobj, s_id_attr)
+                                 : NULL;
+        int ok = 0;
+        if (ident != NULL) {
+          int overflow = 0;
+          long long pv = PyLong_AsLongLongAndOverflow(perms, &overflow);
+          if (!overflow && !(pv == -1 && PyErr_Occurred())
+              && pv >= INT32_MIN && pv <= INT32_MAX) {
+            wr_i32(w, (int32_t)pv);
+            ok = wr_str_obj(w, scheme) && wr_str_obj(w, ident);
+          }
+        }
+        PyErr_Clear();
+        Py_XDECREF(perms);
+        Py_XDECREF(idobj);
+        Py_XDECREF(scheme);
+        Py_XDECREF(ident);
+        Py_DECREF(entry);
+        if (!ok) {
+          Py_DECREF(acl);
+          return 0;
+        }
+      }
+      Py_DECREF(acl);
+      /* flags: missing defaults to 0; negatives fall back — the
+       * Python spec normalizes them through CreateFlag (e.g. -1
+       * becomes 3), which the verbatim C write would diverge from */
+      int64_t flags = 0;
+      PyObject *fv = PyDict_GetItemWithError(pkt, s_flags);
+      if (fv != NULL) {
+        int overflow = 0;
+        long long ll = PyLong_AsLongLongAndOverflow(fv, &overflow);
+        if (overflow || (ll == -1 && PyErr_Occurred())) {
+          PyErr_Clear();
+          return 0;
+        }
+        if (ll < 0 || ll > INT32_MAX) return 0;
+        flags = ll;
+      } else {
+        PyErr_Clear();
+      }
+      wr_i32(w, (int32_t)flags);
+      return 1;
+    }
+    default: /* SET_WATCHES is resume-time-rare; Python handles it */
       return 0;
   }
 }
@@ -1055,5 +1123,8 @@ PyMODINIT_FUNC PyInit__zkwire_ext(void) {
   s_createdOrDestroyed =
       PyUnicode_InternFromString("createdOrDestroyed");
   s_childrenChanged = PyUnicode_InternFromString("childrenChanged");
+  s_perms = PyUnicode_InternFromString("perms");
+  s_scheme = PyUnicode_InternFromString("scheme");
+  s_id_attr = PyUnicode_InternFromString("id");
   return PyModule_Create(&moduledef);
 }

@@ -342,10 +342,10 @@ def test_server_direction_error_contracts():
 
 def test_encode_equivalence_both_directions():
     """The C encoders produce byte-identical frames to the Python
-    JuteWriter for every supported shape, and return None (Python
-    fallback) for the rare shapes they skip (CREATE requests with
-    ACLs, GET_ACL responses, SET_WATCHES) — so PacketCodec.encode is
-    byte-stable regardless of which side ran."""
+    JuteWriter for every supported shape (including CREATE with its
+    ACL list), and return None (Python fallback) for the shapes they
+    skip (GET_ACL responses, SET_WATCHES, out-of-range fields) — so
+    PacketCodec.encode is byte-stable regardless of which side ran."""
     ext = native.ensure_ext()
     py = PacketCodec(use_native=False)
     cx = PacketCodec(use_native=True)
@@ -370,6 +370,28 @@ def test_encode_equivalence_both_directions():
     assert ext.encode_request(
         {'xid': 1, 'opcode': 'DELETE', 'path': '/x',
          'version': 1 << 40}) is None
+    # negative CREATE flags decline: the Python spec normalizes them
+    # through CreateFlag (-1 -> 3); both paths must emit those bytes
+    neg = {'xid': 1, 'opcode': 'CREATE', 'path': '/n', 'data': b'',
+           'acl': list(records.OPEN_ACL_UNSAFE), 'flags': -1}
+    assert ext.encode_request(dict(neg)) is None
+    py2 = PacketCodec(use_native=False)
+    cx2 = PacketCodec(use_native=True)
+    py2.handshaking = cx2.handshaking = False
+    assert py2.encode(dict(neg)) == cx2.encode(dict(neg))
+
+    # hostile ACL entries (attribute access runs arbitrary code that
+    # mutates the list mid-encode) must fall back, never crash
+    hostile_acl: list = []
+
+    class Hostile:
+        def __getattr__(self, name):
+            hostile_acl.clear()   # shrink the list under the C loop
+            raise AttributeError(name)
+    hostile_acl.extend([Hostile(), Hostile()])
+    hostile = {'xid': 1, 'opcode': 'CREATE', 'path': '/n', 'data': b'',
+               'acl': hostile_acl, 'flags': 0}
+    assert ext.encode_request(hostile) is None
 
 
 def test_randomized_fleet_equivalence():

@@ -118,6 +118,10 @@ def main() -> int:
         {'xid': 1, 'opcode': 'GET_DATA', 'path': '/a', 'watch': True},
         {'xid': 1, 'opcode': 'SET_DATA', 'path': '/a', 'data': b'x',
          'version': 0},
+        {'xid': 1, 'opcode': 'CREATE', 'path': '/n', 'data': b'd',
+         'acl': list(records.OPEN_ACL_UNSAFE), 'flags': 1},
+        {'xid': 1, 'opcode': 'CREATE', 'path': '/n', 'data': b'd',
+         'acl': [object()], 'flags': 1},     # near-miss ACL entry
         {'xid': 1, 'opcode': 'GET_DATA', 'path': 42, 'watch': True},
         {'xid': 'bad', 'opcode': 'PING'},
     ]
