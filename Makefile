@@ -6,7 +6,7 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench asan clean
+.PHONY: all test check native bench asan coverage clean
 
 all: check test
 
@@ -32,7 +32,15 @@ asan:
 bench:
 	$(PYTHON) bench.py
 
+# Line coverage (reference Makefile:61-66 istanbul analogue).  No
+# coverage package in this image; tools/cover.py implements it on
+# sys.monitoring (PEP 669) — once-per-line callbacks with DISABLE, so
+# the suite runs at near-native speed.  Writes COVERAGE.txt.
+coverage: native
+	$(PYTHON) tools/cover.py tests/ -q
+
 clean:
+	rm -f COVERAGE.txt
 	rm -rf native/*.so native/*.so.tmp.* \
 	    $$(find . -name __pycache__ -not -path './.git/*') \
 	    .pytest_cache

@@ -254,10 +254,17 @@ static PyObject *rd_acl_list(Cursor *c) {
   return lst;
 }
 
-/* dict[int] lookup helper; returns borrowed ref or NULL (no exception) */
+/* dict[int] lookup helper; returns borrowed ref or NULL (no exception).
+ * NULL uniformly means "treat as absent": callers take their scalar
+ * fallback branch, so a failure here (key alloc under OOM included)
+ * must clear the error — returning NULL with a live exception would
+ * let a success value escape with the exception still set. */
 static PyObject *int_key_get(PyObject *dict, long long key) {
   PyObject *k = PyLong_FromLongLong(key);
-  if (k == NULL) return NULL;
+  if (k == NULL) {
+    PyErr_Clear();
+    return NULL;
+  }
   PyObject *v = PyDict_GetItemWithError(dict, k); /* borrowed */
   Py_DECREF(k);
   if (v == NULL) PyErr_Clear();
@@ -1079,7 +1086,9 @@ static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
 static PyMethodDef methods[] = {
     {"setup", py_setup, METH_VARARGS,
      "setup(Stat, ACL, Id, Perm, CreateFlag, err_names, notif_types, "
-     "states, layouts, req_opcodes, op_names)"},
+     "states, layouts, req_opcodes, op_names, err_codes, notif_codes, "
+     "state_codes, op_codes) — see native.ext_setup_args() for the "
+     "canonical argument builder"},
     {"decode_responses", py_decode_responses, METH_VARARGS,
      "decode_responses(buf, xid_map, max_packet) -> "
      "(pkts, consumed, err_kind, err_msg)"},

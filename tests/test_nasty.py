@@ -198,6 +198,38 @@ async def test_recovery_after_failed():
     await srv.stop()
 
 
+async def test_wait_connected_fail_fast_contract():
+    """wait_connected's 'failed' contract (client.py): fail_fast=True
+    surfaces policy exhaustion (immediately when the pool is already in
+    monitor mode); fail_fast=False rides monitor mode and completes
+    when a backend appears after exhaustion."""
+    tmp = await asyncio.start_server(lambda r, w: None, '127.0.0.1', 0)
+    port = tmp.sockets[0].getsockname()[1]
+    tmp.close()
+    await tmp.wait_closed()
+
+    c, failed, connected = failing_client(port)
+    # A fail_fast waiter registered BEFORE exhaustion gets the edge.
+    with pytest.raises(ZKNotConnectedError):
+        await c.wait_connected(timeout=10)
+    assert failed
+    # Pool is now in monitor mode: fail_fast=True raises immediately...
+    assert c.pool.state == 'failed'
+    with pytest.raises(ZKNotConnectedError):
+        await c.wait_connected(timeout=10)
+    # ...but a patient waiter survives the (already-passed) edge and
+    # completes once monitor mode lands a connection.
+    waiter = asyncio.ensure_future(
+        c.wait_connected(timeout=15, fail_fast=False))
+    await asyncio.sleep(0.3)
+    assert not waiter.done()
+    srv = await ZKServer(host='127.0.0.1', port=port).start()
+    await waiter
+    assert await c.ping() >= 0
+    await c.close()
+    await srv.stop()
+
+
 async def test_argument_validation():
     c = Client(address='127.0.0.1', port=1)
     with pytest.raises(TypeError):

@@ -259,12 +259,24 @@ class Client(FSM):
         conn = self.current_connection()
         return conn is not None and conn.is_in_state('connected')
 
-    async def wait_connected(self, timeout: float | None = None) -> None:
-        """Convenience: wait until the client is usable (or raise on
-        terminal failure / timeout)."""
+    async def wait_connected(self, timeout: float | None = None,
+                             fail_fast: bool = True) -> None:
+        """Wait until the client is usable.
+
+        Contract for ``failed``: it is an **edge event**, not a terminal
+        state — it fires once when the initial retry policy exhausts on
+        every backend, after which the pool keeps dialing forever in
+        monitor mode (cueball's failed-state semantics, reference:
+        lib/client.js:96-111) and may still recover.  With the default
+        ``fail_fast=True`` this method surfaces the exhaustion as
+        :class:`ZKNotConnectedError` — immediately if the pool is
+        already in monitor mode, or on the ``failed`` edge while
+        waiting.  With ``fail_fast=False`` policy exhaustion is ignored
+        and the wait rides monitor mode until a connection lands or
+        ``timeout`` expires (``asyncio.TimeoutError``)."""
         if self.is_connected():
             return
-        if self.pool.state == 'failed':
+        if fail_fast and self.pool.state == 'failed':
             # 'failed' is edge-triggered; a pool already in monitor mode
             # will not re-emit it, so report the failure immediately.
             raise ZKNotConnectedError()
@@ -276,7 +288,7 @@ class Client(FSM):
                 fut.set_result(None)
 
         def on_failed(err):
-            if not fut.done():
+            if fail_fast and not fut.done():
                 fut.set_exception(err)
         self.on('connect', on_connect)
         self.on('failed', on_failed)

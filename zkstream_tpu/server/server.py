@@ -85,7 +85,11 @@ class ServerConnection:
         if cache is not None and cache[0] == key:
             data = cache[1]
         else:
-            data = self.codec.encode(
+            # Encode through the server-owned connection-independent
+            # codec, not this connection's: the cached bytes are shared
+            # with every subscribed connection, so they must not depend
+            # on any per-connection encode state.
+            data = self.server._notif_codec.encode(
                 {'xid': XID_NOTIFICATION, 'zxid': self.db.zxid,
                  'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
                  'state': 'SYNC_CONNECTED', 'path': path})
@@ -338,8 +342,13 @@ class ZKServer:
         self.drop_pings = False
         self.drop_replies = False
         #: one-slot encode cache for notification fan-out
-        #: ((type, path, zxid), wire bytes)
+        #: ((type, path, zxid), wire bytes), filled via the dedicated
+        #: connection-independent codec below (the bytes are shared
+        #: across subscribers, so no per-connection codec may encode
+        #: them)
         self._notif_cache: tuple[tuple, bytes] | None = None
+        self._notif_codec = PacketCodec(server=True)
+        self._notif_codec.handshaking = False
 
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(
