@@ -154,7 +154,7 @@ async def test_megabyte_payload_all_codec_paths(server):
         dict(use_native_codec=False),
         dict(use_native_codec=None),       # ext when built
         dict(ingest=FleetIngest(body_mode='host', max_frames=4,
-                                bypass_bytes=0)),
+                                bypass_bytes=0, warm='block')),
     ]
     for i, kw in enumerate(configs):
         c = Client(address='127.0.0.1', port=server.port,

@@ -96,13 +96,14 @@ async def test_ingest_semantics_match_scalar_drain():
     scalar = await _run_mode(None)
 
     host_ing = FleetIngest(body_mode='host', max_frames=8, min_len=256,
-                           bypass_bytes=0)
+                           bypass_bytes=0, warm='block')
     host = await _run_mode(host_ing)
     assert host == scalar
     assert host_ing.ticks > 0 and host_ing.frames_routed > 0
 
     dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256,
-                          bypass_bytes=0, max_data=128, max_path=64)
+                          bypass_bytes=0, max_data=128, max_path=64,
+                          warm='block')
     dev = await _run_mode(dev_ing)
     assert dev == scalar
     assert dev_ing.ticks > 0 and dev_ing.frames_routed > 0
@@ -112,7 +113,8 @@ async def test_ingest_small_tick_bypass():
     """With the default crossover enabled, small ticks drain through
     the scalar codec (no device dispatch) with identical semantics;
     the device pipeline engages only past the byte threshold."""
-    ingest = FleetIngest(body_mode='host', max_frames=8)  # default bypass
+    ingest = FleetIngest(body_mode='host', max_frames=8,
+                         warm='block')  # default bypass
     assert ingest.bypass_bytes > 0
     scalar = await _run_mode(None)
     got = await _run_mode(ingest)
@@ -123,7 +125,8 @@ async def test_ingest_small_tick_bypass():
 
     # force a tick over the threshold: every buffered byte beyond
     # bypass_bytes must go through the device path
-    big = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=64)
+    big = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=64,
+                      warm='block')
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=big)
     try:
@@ -141,7 +144,8 @@ async def test_ingest_device_fallbacks():
     """Oversized data fields and list-shaped bodies take the scalar
     fallback inside the device body mode, transparently."""
     ingest = FleetIngest(body_mode='device', max_frames=8, bypass_bytes=0,
-                         max_data=8, max_path=8)  # force fallbacks
+                         max_data=8, max_path=8,  # force fallbacks
+                         warm='block')
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
     try:
@@ -166,7 +170,7 @@ async def test_ingest_fleet_256_connections(event_loop):
     path."""
     B = 256
     ingest = FleetIngest(body_mode='host', max_frames=8, min_len=256,
-                         bypass_bytes=0)
+                         bypass_bytes=0, warm='block')
     srv = await ZKServer().start()
     clients = [make_client(srv.port, ingest=ingest) for _ in range(B)]
     try:
@@ -267,7 +271,8 @@ async def test_ingest_bad_length_parity(split_writes):
     segment with a good reply."""
     scalar = await _bad_length_scenario(None, split_writes)
     fleet = await _bad_length_scenario(
-        FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0),
+        FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0,
+                    warm='block'),
         split_writes)
     assert fleet == scalar
     assert scalar[1] == 'BAD_LENGTH'
@@ -319,7 +324,8 @@ async def test_ingest_corrupt_ustring_parity():
     assert scalar == ('raise', 'ZKProtocolError', 'BAD_DECODE')
     for mode in ('host', 'device'):
         got = await _corrupt_create_scenario(
-            FleetIngest(body_mode=mode, max_frames=8, bypass_bytes=0))
+            FleetIngest(body_mode=mode, max_frames=8, bypass_bytes=0,
+                        warm='block'))
         assert got == scalar, (mode, got)
 
 
@@ -328,7 +334,7 @@ async def test_ingest_host_placement():
     serves traffic normally (the latency-aware fallback for tunneled
     accelerators whose dispatch RTT exceeds the tick budget)."""
     ingest = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0,
-                         placement='host')
+                         placement='host', warm='block')
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
     try:
@@ -344,10 +350,59 @@ async def test_ingest_host_placement():
         await srv.stop()
 
 
+async def test_ingest_background_warm():
+    """Under the production default warm='background', a tick whose
+    shape bucket has no compiled program yet never blocks the loop: it
+    drains through the scalar codec (identical semantics, counted as
+    ticks_warming) while the AOT compile runs on a daemon thread, and
+    once the bucket lands the device path engages."""
+    ingest = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0)
+    assert ingest.warm == 'background'
+    srv = await ZKServer().start()
+    c = make_client(srv.port, ingest=ingest)
+    try:
+        await c.wait_connected(timeout=5)
+        # cold bucket: ops are served scalar while the compile runs
+        await c.create('/w1', b'a')
+        data, _stat = await c.get('/w1')
+        assert data == b'a'
+        # the first tick found a cold bucket and drained scalar (no
+        # ticks==0 assertion: the background compile may land at any
+        # point after it)
+        assert ingest.ticks_warming > 0
+        # the same bucket the runtime traffic hits, compiled up front
+        await ingest.prewarm(1)
+        before = ingest.ticks
+        data, _stat = await c.get('/w1')
+        assert data == b'a'
+        await wait_until(lambda: ingest.ticks > before, timeout=5)
+    finally:
+        await c.close()
+        await srv.stop()
+
+
+async def test_ingest_prewarm_block_mode():
+    """prewarm under warm='block' compiles synchronously; the first
+    real tick then runs the device path immediately."""
+    ingest = FleetIngest(warm='block', body_mode='host', max_frames=8,
+                         bypass_bytes=0)
+    srv = await ZKServer().start()
+    c = make_client(srv.port, ingest=ingest)
+    try:
+        await c.wait_connected(timeout=5)
+        await ingest.prewarm(1)
+        await c.create('/p', b'q')
+        assert ingest.ticks > 0 and ingest.ticks_warming == 0
+    finally:
+        await c.close()
+        await srv.stop()
+
+
 async def test_ingest_reticks_past_max_frames():
     """More complete frames buffered than max_frames in one tick are
     finished on follow-up ticks, none lost."""
-    ingest = FleetIngest(body_mode='host', max_frames=2, bypass_bytes=0)
+    ingest = FleetIngest(body_mode='host', max_frames=2, bypass_bytes=0,
+                         warm='block')
     srv = await ZKServer().start()
     c = make_client(srv.port, ingest=ingest)
     try:

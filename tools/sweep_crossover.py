@@ -1,0 +1,172 @@
+"""Crossover sweep: where does the batched device plane win end-to-end?
+
+Measures the full client stack against the in-process server across
+fleet sizes x client receive paths (VERDICT r2 item 1):
+
+  python     pure-Python scalar codec — the reference-idiom baseline
+             (lib/zk-streams.js:39-99 is an interpreted per-socket
+             drain too)
+  native     C-extension scalar codec, per-socket drain
+  ingest     FleetIngest, device framing + C slice assembly
+  ingest-py  FleetIngest with the C codec disabled on its connections:
+             device framing + plane assembly — the no-native-toolchain
+             regime (only an interpreted host codec available)
+
+Workloads per cell: concurrent gets (per-op latency), and a
+notification fan-out storm (every connection watches one node; one set
+fires N notifications + N re-arm reads through the stack) — the
+fleet-scale workload the batcher exists for.
+
+Emits one JSON line per cell to stdout; run via
+  python tools/sweep_crossover.py [--conns 32,256] [--modes ...]
+and paste the table into CROSSOVER.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GETS_TOTAL = 2048        # total get ops per cell, split over the fleet
+STORMS = 5               # fan-out storms per cell
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))]
+
+
+async def run_cell(mode: str, n_conns: int) -> dict:
+    from zkstream_tpu import Client
+    from zkstream_tpu.server import ZKServer
+
+    ingest = None
+    kw: dict = {}
+    if mode == 'ingest':
+        from zkstream_tpu.io.ingest import FleetIngest
+        ingest = FleetIngest(body_mode='host', max_frames=16,
+                             bypass_bytes=0)
+    elif mode == 'ingest-py':
+        from zkstream_tpu.io.ingest import FleetIngest
+        ingest = FleetIngest(body_mode='host', max_frames=16,
+                             bypass_bytes=0)
+        kw['use_native_codec'] = False
+    elif mode == 'native':
+        kw['use_native_codec'] = True
+    elif mode == 'python':
+        kw['use_native_codec'] = False
+    else:
+        raise ValueError(mode)
+
+    loop = asyncio.get_running_loop()
+    srv = await ZKServer().start()
+    clients = [Client(address='127.0.0.1', port=srv.port,
+                      session_timeout=60000, ingest=ingest, **kw)
+               for _ in range(n_conns)]
+    for c in clients:
+        c.start()
+    await asyncio.gather(*[c.wait_connected(timeout=60)
+                           for c in clients])
+    out = {'mode': mode, 'conns': n_conns}
+    try:
+        await clients[0].create('/b', b'x' * 64)
+        if ingest is not None:
+            bp = 8
+            while bp < n_conns:
+                await ingest.prewarm(bp)
+                await ingest.prewarm(bp, 512)
+                bp *= 2
+            await ingest.prewarm(n_conns)
+            await ingest.prewarm(n_conns, 512)
+
+        # warm steady state
+        for _ in range(3):
+            await asyncio.gather(*[c.get('/b') for c in clients])
+
+        # -- concurrent gets --
+        per = max(4, GETS_TOTAL // n_conns)
+        lat: list[float] = []
+
+        async def getter(c):
+            for _ in range(per):
+                t0 = loop.time()
+                await c.get('/b')
+                lat.append((loop.time() - t0) * 1000.0)
+        t0 = loop.time()
+        await asyncio.gather(*[getter(c) for c in clients])
+        dt = loop.time() - t0
+        out['get'] = {
+            'ops_per_sec': round(len(lat) / dt, 1),
+            'p50_ms': round(_pct(lat, 50), 3),
+            'p99_ms': round(_pct(lat, 99), 3)}
+
+        # -- notification fan-out storm --
+        fired = [0]
+        got_all = [None]
+
+        def on_fire(*a):
+            fired[0] += 1
+            if fired[0] >= n_conns and got_all[0] is not None \
+                    and not got_all[0].done():
+                got_all[0].set_result(None)
+        for c in clients:
+            c.watcher('/b').on('dataChanged', on_fire)
+        # arming emits once per client; swallow those
+        await asyncio.sleep(0.1)
+        while fired[0] < n_conns:
+            await asyncio.sleep(0.1)
+        storm_dts = []
+        for s in range(STORMS):
+            await asyncio.sleep(0.3)   # let every watch re-arm
+            fired[0] = 0
+            got_all[0] = loop.create_future()
+            t0 = loop.time()
+            await clients[0].set('/b', b'z%d' % s)
+            await asyncio.wait_for(got_all[0], 30)
+            storm_dts.append(loop.time() - t0)
+        best = min(storm_dts)
+        out['fanout'] = {
+            'events': n_conns,
+            'best_events_per_sec': round(n_conns / best, 1),
+            'best_ms': round(best * 1000.0, 2)}
+        if ingest is not None:
+            out['ingest'] = {
+                'ticks': ingest.ticks,
+                'scalar_ticks': ingest.ticks_scalar,
+                'warming_ticks': ingest.ticks_warming,
+                'frames': ingest.frames_routed,
+                'frames_per_tick': round(
+                    ingest.frames_routed / max(1, ingest.ticks), 1)}
+    finally:
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--conns', default='32,128,256,512')
+    ap.add_argument('--modes', default='python,native,ingest,ingest-py')
+    args = ap.parse_args()
+    conns = [int(x) for x in args.conns.split(',')]
+    modes = args.modes.split(',')
+    for n in conns:
+        for mode in modes:
+            t0 = time.time()
+            try:
+                r = asyncio.run(run_cell(mode, n))
+            except Exception as e:
+                r = {'mode': mode, 'conns': n, 'error': repr(e)}
+            r['cell_s'] = round(time.time() - t0, 1)
+            print(json.dumps(r), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -20,14 +20,16 @@ Division of labor per tick:
 - **device**: frame boundary scan, reply-header parse (xid/zxid/err),
   per-stream routing counts, bad-frame flags — the O(bytes) work;
 - **host**: per-frame packet-dict assembly.  In ``body_mode='host'``
-  the opcode-specific body is parsed by the scalar readers positioned
-  at the device-located body offset (no re-framing, exact parity by
-  construction).  In ``body_mode='device'`` fixed-layout bodies
-  (Stat / data / create-path / notification) come from the tensor
-  planes, with the scalar readers as fallback for list-shaped bodies
-  (children / ACL), oversized variable fields, and malformed frames —
-  so a protocol violation raises byte-for-byte the same error the
-  scalar codec would.
+  the packets come from the C-extension decoder when it is loaded (one
+  zero-copy pass over the device-delimited complete-frame slice —
+  byte-identical to the scalar drain because it *is* the scalar
+  decoder), else from the scalar readers positioned at the
+  device-located body offsets.  In ``body_mode='device'`` fixed-layout
+  bodies (Stat / data / create-path / notification) come from the
+  tensor planes, with the scalar readers as fallback for list-shaped
+  bodies (children / ACL), oversized variable fields, and malformed
+  frames — so a protocol violation raises byte-for-byte the same error
+  the scalar codec would.
 
 Streams flagged ``bad`` by the device scan re-run through the
 connection's own ``PacketCodec`` so the error surfaced (BAD_LENGTH /
@@ -37,16 +39,32 @@ exactly.
 The tick is synchronous inside the event loop: all ``data_received``
 callbacks of one select cycle run before the ``call_soon``-scheduled
 tick, so one dispatch coalesces everything the loop just read.
+
+**No tick ever blocks on XLA.**  Compiling the tick program for a new
+(batch, length) bucket costs ~1 s on the host CPU backend — 3 orders
+of magnitude over a steady tick — and the first-dispatch latency probe
+on a tunneled accelerator costs several round trips.  Both therefore
+run off-loop: under the default ``warm='background'`` a tick whose
+shape bucket has no compiled executable yet is delivered through the
+scalar codec (identical semantics) while a daemon thread AOT-compiles
+the bucket (``jit(...).lower(...).compile()``); once it lands,
+subsequent ticks run the device program.  ``warm='block'`` compiles
+inline on first use — deterministic, for tests and one-shot tools —
+and :meth:`prewarm` lets benchmarks/servers pay the compile up front.
+This is what bounds the ingest latency tail: the worst tick costs
+max(scalar drain, steady device tick), never a compile
+(measured: tools/diag_ingest.py; VERDICT r2 item 2).
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import TYPE_CHECKING
 
 import numpy as np
 
-from ..protocol.consts import REPLY_HDR, SPECIAL_XIDS, err_name
+from ..protocol.consts import MAX_PACKET, REPLY_HDR, SPECIAL_XIDS, err_name
 from ..protocol.errors import ZKProtocolError
 from ..protocol.jute import JuteReader
 from ..protocol.records import (
@@ -65,6 +83,11 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+#: sentinel distinguishing "never compiled" from "compile failed" in
+#: the executable cache
+_MISSING = object()
+
+
 class FleetIngest:
     """Batches the byte streams of many live connections through the
     device wire pipeline, one dispatch per event-loop tick.
@@ -72,13 +95,18 @@ class FleetIngest:
     Args:
       max_frames: static per-stream frame bound per tick; streams with
         more complete frames buffered are finished on follow-up ticks.
-      body_mode: ``'host'`` (device framing/headers, scalar body
+      body_mode: ``'host'`` (device framing/headers, C/scalar body
         readers) or ``'device'`` (tensor body parse with scalar
         fallback).
       max_data / max_path: static widths for the device body planes
         (``body_mode='device'`` only); larger fields fall back to the
         scalar reader.
       min_len: smallest padded stream length, to bound jit cache churn.
+      warm: ``'background'`` (default) — a tick whose shape bucket is
+        not compiled yet delivers through the scalar codec while the
+        XLA program compiles on a daemon thread, so the event loop
+        never blocks on a compile; ``'block'`` — compile inline on
+        first use (deterministic; tests/tools).
       log: parent logger.
     """
 
@@ -87,21 +115,25 @@ class FleetIngest:
                  min_len: int = 256, placement: str = 'auto',
                  latency_budget_ms: float = 5.0,
                  bypass_bytes: int = 32768,
+                 warm: str = 'background',
                  log: Logger | None = None):
         assert body_mode in ('host', 'device'), body_mode
         assert placement in ('auto', 'accelerator', 'host'), placement
+        assert warm in ('background', 'block'), warm
         self.max_frames = max_frames
         self.body_mode = body_mode
         self.max_data = max_data
         self.max_path = max_path
         self.min_len = min_len
+        self.warm = warm
         #: Small-tick crossover: when a tick holds fewer than this many
         #: buffered wire bytes in total, the batch dispatch + readback
         #: costs more than it saves, so the tick drains each stream
         #: through its connection's own scalar codec (C-accelerated
         #: when built) instead — identical observable semantics, the
         #: scalar path being the spec.  0 forces every tick onto the
-        #: device pipeline (tests, benchmarks).
+        #: device pipeline (tests, benchmarks).  The default is
+        #: calibrated from the measured crossover sweep (CROSSOVER.md).
         self.bypass_bytes = bypass_bytes
         #: Where the tick's XLA program runs.  A tick is latency-bound
         #: (one dispatch + one readback inside the event loop), so
@@ -112,18 +144,26 @@ class FleetIngest:
         #: benchmarks) is unaffected and stays on the accelerator.
         self.placement = placement
         self.latency_budget_ms = latency_budget_ms
-        self._device = None        # resolved lazily at first tick
+        self._device = None        # resolved lazily at first warm
         self._placed = False
+        self._place_lock = threading.Lock()
         self.log = (log or Logger()).child(component='FleetIngest')
         #: id(conn) -> (conn, accumulator)
         self._slots: dict[int, tuple['ZKConnection', bytearray]] = {}
         self._scheduled = False
         #: diagnostics for tests/benchmarks (``ticks`` counts device
-        #: ticks; small ticks under ``bypass_bytes`` count separately)
+        #: ticks; small ticks under ``bypass_bytes`` and ticks deferred
+        #: to the scalar drain while a shape bucket compiles count
+        #: separately)
         self.ticks = 0
         self.ticks_scalar = 0
+        self.ticks_warming = 0
         self.frames_routed = 0
         self._fns: dict = {}
+        #: (device_bodies, Bp, L) -> AOT executable (None = compile
+        #: failed; that bucket stays on the scalar drain)
+        self._exec: dict = {}
+        self._warm_events: dict = {}
 
     # -- connection registry --
 
@@ -169,8 +209,9 @@ class FleetIngest:
                   'npath_len', 'data_ok', 'str0_ok', 'npath_ok')
 
     def _step_fn(self, device_bodies: bool):
-        """Build (and cache) the jitted one-dispatch decode for this
-        configuration; shapes vary per call, jit caches per shape.
+        """Build (and cache) the jittable one-dispatch decode for this
+        configuration — the lowering source for the per-shape AOT
+        executables (:meth:`_compile`).
 
         Everything the host needs comes back as ONE packed int32 array
         (plus one uint8 array in device-body mode): on a tunneled
@@ -225,6 +266,159 @@ class FleetIngest:
             self._fns[key] = fn
         return fn
 
+    # -- shape-bucket warm-up (AOT compile off the event loop) --
+
+    def _bucket(self, n_streams: int, nbytes: int) -> tuple:
+        Bp = _next_pow2(max(n_streams, 8))
+        L = _next_pow2(max(self.min_len, nbytes))
+        return (self.body_mode == 'device', Bp, L)
+
+    def _compile(self, key: tuple):
+        """Lower + AOT-compile the tick program for one shape bucket.
+        Runs on the warm thread (or inline under warm='block')."""
+        import contextlib
+
+        import jax
+
+        device_bodies, Bp, L = key
+        self._resolve_placement()
+        fn = self._step_fn(device_bodies)
+        batch = np.zeros((Bp, L), np.uint8)
+        lens = np.zeros((Bp,), np.int32)
+        ctx = (jax.default_device(self._device) if self._device is not
+               None else contextlib.nullcontext())
+        with ctx:
+            if device_bodies:
+                lowered = fn.lower(batch, lens,
+                                   max_frames=self.max_frames,
+                                   max_data=self.max_data,
+                                   max_path=self.max_path)
+            else:
+                lowered = fn.lower(batch, lens,
+                                   max_frames=self.max_frames)
+            return lowered.compile()
+
+    def _try_compile(self, key: tuple):
+        """Compile ``key``'s bucket; a failure logs and returns None
+        (one policy for the inline and background warm paths)."""
+        try:
+            return self._compile(key)
+        except Exception as e:
+            self.log.warning('tick program compile failed for '
+                             'bucket %r: %s', key, e)
+            return None
+
+    def _compile_or_latch(self, key: tuple):
+        """Inline warm: compile and store, latching a failure as None
+        so the bucket permanently drains scalar."""
+        ex = self._exec[key] = self._try_compile(key)
+        return ex
+
+    def _start_warm(self, key: tuple) -> asyncio.Event:
+        """Kick off (or join) the background compile for ``key``;
+        returns the event set when the bucket is ready (or failed)."""
+        ev = self._warm_events.get(key)
+        if ev is not None:
+            return ev
+        ev = asyncio.Event()
+        self._warm_events[key] = ev
+        loop = asyncio.get_running_loop()
+
+        def work():
+            ex = self._try_compile(key)
+            try:
+                # the _exec write happens on the loop thread (done)
+                loop.call_soon_threadsafe(done, ex)
+            except RuntimeError:     # loop closed mid-compile
+                pass
+
+        def done(ex):
+            self._exec[key] = ex
+            ev.set()
+            # bytes may be waiting that deferred to scalar meanwhile
+            self._schedule()
+
+        threading.Thread(target=work, daemon=True,
+                         name='ingest-warm').start()
+        return ev
+
+    async def prewarm(self, n_streams: int,
+                      nbytes: int | None = None) -> None:
+        """Compile the tick program for an expected fleet shape up
+        front (servers at startup, benchmarks before timing): the
+        bucket for ``n_streams`` connections holding up to ``nbytes``
+        buffered bytes each tick (default: ``min_len``)."""
+        key = self._bucket(n_streams, nbytes or self.min_len)
+        if self._exec.get(key, _MISSING) is not _MISSING:
+            return
+        if self.warm == 'block':
+            self._compile_or_latch(key)
+            return
+        await self._start_warm(key).wait()
+
+    @staticmethod
+    def _cpu_device(timeout_s: float = 15.0):
+        """Initialize and return the host CPU backend's device, bounded
+        in time: PJRT client creation for a second backend can block
+        indefinitely in degraded environments (observed with a wedged
+        remote-TPU tunnel), and a latency *optimization* must never be
+        able to hang the runtime.  Returns None on timeout/failure (the
+        ticks then stay on the default device)."""
+        out: dict = {}
+
+        def init():
+            try:
+                import jax
+                out['dev'] = jax.devices('cpu')[0]
+            except Exception:
+                out['dev'] = None
+        t = threading.Thread(target=init, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        return out.get('dev')
+
+    def _resolve_placement(self) -> None:
+        """Pick the tick's execution device (once, at first warm-up —
+        never on the event loop under warm='background': the probe
+        costs several accelerator round trips)."""
+        with self._place_lock:
+            if self._placed:
+                return
+            self._placed = True
+            import time
+
+            import jax
+            import jax.numpy as jnp
+
+            if self.placement == 'accelerator':
+                return
+            cpu = self._cpu_device()
+            if cpu is None:
+                self.log.warning('host CPU backend unavailable; ticks '
+                                 'stay on the default device')
+                return
+            if self.placement == 'host':
+                self._device = cpu
+                return
+            if jax.default_backend() == 'cpu':
+                return
+            # auto: measure the dispatch->readback round trip of a
+            # trivial program on the default device — the floor every
+            # tick pays.
+            probe = jax.jit(lambda x: x + 1)
+            x = jnp.zeros((8,), jnp.int32)
+            np.asarray(probe(x))  # compile + first (poisoning) readback
+            t0 = time.perf_counter()
+            for _ in range(3):
+                np.asarray(probe(x))
+            rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
+            if rtt_ms > self.latency_budget_ms:
+                self._device = cpu
+                self.log.info(
+                    'accelerator dispatch+readback RTT %.1f ms exceeds '
+                    'the %.1f ms tick budget; running ticks on the '
+                    'host CPU backend', rtt_ms, self.latency_budget_ms)
+
     def _unpack(self, ints, byts):
         """Rebuild the host-side stat/body views from the packed
         arrays (numpy views, no copies)."""
@@ -263,67 +457,6 @@ class FleetIngest:
                 **{f: fields[f] for f in self._BD_PLANES})
         return st, bd
 
-    @staticmethod
-    def _cpu_device(timeout_s: float = 15.0):
-        """Initialize and return the host CPU backend's device, bounded
-        in time: PJRT client creation for a second backend can block
-        indefinitely in degraded environments (observed with a wedged
-        remote-TPU tunnel), and a latency *optimization* must never be
-        able to hang the runtime.  Returns None on timeout/failure (the
-        ticks then stay on the default device)."""
-        import threading
-
-        out: dict = {}
-
-        def init():
-            try:
-                import jax
-                out['dev'] = jax.devices('cpu')[0]
-            except Exception:
-                out['dev'] = None
-        t = threading.Thread(target=init, daemon=True)
-        t.start()
-        t.join(timeout_s)
-        return out.get('dev')
-
-    def _resolve_placement(self) -> None:
-        """Pick the tick's execution device (once, at first tick)."""
-        if self._placed:
-            return
-        self._placed = True
-        import time
-
-        import jax
-        import jax.numpy as jnp
-
-        if self.placement == 'accelerator':
-            return
-        cpu = self._cpu_device()
-        if cpu is None:
-            self.log.warning('host CPU backend unavailable; ticks stay '
-                             'on the default device')
-            return
-        if self.placement == 'host':
-            self._device = cpu
-            return
-        if jax.default_backend() == 'cpu':
-            return
-        # auto: measure the dispatch->readback round trip of a trivial
-        # program on the default device — the floor every tick pays.
-        probe = jax.jit(lambda x: x + 1)
-        x = jnp.zeros((8,), jnp.int32)
-        np.asarray(probe(x))  # compile + first (poisoning) readback
-        t0 = time.perf_counter()
-        for _ in range(3):
-            np.asarray(probe(x))
-        rtt_ms = (time.perf_counter() - t0) / 3 * 1e3
-        if rtt_ms > self.latency_budget_ms:
-            self._device = cpu
-            self.log.info(
-                'accelerator dispatch+readback RTT %.1f ms exceeds the '
-                '%.1f ms tick budget; running ticks on the host CPU '
-                'backend', rtt_ms, self.latency_budget_ms)
-
     def _tick(self) -> None:
         self._scheduled = False
         active = [(conn, buf) for conn, buf in self._slots.values()
@@ -338,13 +471,34 @@ class FleetIngest:
                     continue
                 self._deliver_scalar(conn, buf)
             return
-        self.ticks += 1
-        self._resolve_placement()
 
         B = len(active)
-        L = _next_pow2(max(self.min_len,
-                           max(len(buf) for _c, buf in active)))
-        Bp = _next_pow2(max(B, 8))
+        maxlen = max(len(buf) for _c, buf in active)
+        key = self._bucket(B, maxlen)
+        ex = self._exec.get(key, _MISSING)
+        if ex is _MISSING:
+            if self.warm == 'block':
+                ex = self._compile_or_latch(key)
+            else:
+                # never block the loop on a compile: drain this tick
+                # through the scalar codec while the bucket warms
+                self._start_warm(key)
+                self.ticks_warming += 1
+                for conn, buf in active:
+                    if id(conn) not in self._slots:
+                        continue
+                    self._deliver_scalar(conn, buf)
+                return
+        if ex is None:  # compile failed: this bucket stays scalar
+            self.ticks_scalar += 1
+            for conn, buf in active:
+                if id(conn) not in self._slots:
+                    continue
+                self._deliver_scalar(conn, buf)
+            return
+        self.ticks += 1
+
+        device, Bp, L = key
         batch = np.zeros((Bp, L), np.uint8)
         lens = np.zeros((Bp,), np.int32)
         for i, (_conn, buf) in enumerate(active):
@@ -353,23 +507,13 @@ class FleetIngest:
             batch[i, :len(buf)] = np.frombuffer(buf, np.uint8)
             lens[i] = len(buf)
 
-        import contextlib
-
-        import jax
-
-        device = self.body_mode == 'device'
-        fn = self._step_fn(device)
-        ctx = (jax.default_device(self._device) if self._device is not
-               None else contextlib.nullcontext())
-        with ctx:
-            if device:
-                ints, byts = fn(batch, lens, self.max_frames,
-                                self.max_data, self.max_path)
-                ints = np.asarray(ints)  # the only 2 readbacks per tick
-                byts = np.asarray(byts)
-            else:
-                ints = np.asarray(fn(batch, lens, self.max_frames))
-                byts = None
+        if device:
+            ints, byts = ex(batch, lens)
+            ints = np.asarray(ints)  # the only 2 readbacks per tick
+            byts = np.asarray(byts)
+        else:
+            ints = np.asarray(ex(batch, lens))
+            byts = None
         st, bd = self._unpack(ints, byts)
 
         retick = False
@@ -434,12 +578,23 @@ class FleetIngest:
         """Build the packet dicts for stream ``i``'s ``n`` frames.
         Returns (packets, err); a decode failure mid-stream keeps the
         packets decoded before it, like PacketCodec.decode."""
-        from ..ops.bytesops import i64pair_to_int
-
+        if not n:
+            return [], None
+        if bd is None:
+            ext = conn.codec.ext
+            if ext is not None:
+                return self._assemble_ext(conn, buf, st, ext, i)
         pkts: list[dict] = []
         xid_map = conn.codec.xid_map
+        # bulk-convert the header planes for this stream to Python ints
+        # once: per-element numpy scalar indexing and (hi, lo) numpy
+        # arithmetic cost ~10x the whole packet-dict build
+        xids = st.xids[i, :n].tolist()
+        zhis = st.zxid_hi[i, :n].tolist()
+        zlos = st.zxid_lo[i, :n].tolist()
+        errs = st.errs[i, :n].tolist()
         for f in range(n):
-            xid = int(st.xids[i, f])
+            xid = xids[f]
             opcode = SPECIAL_XIDS.get(xid)
             if opcode is None:
                 opcode = xid_map.pop(xid, None)
@@ -447,11 +602,13 @@ class FleetIngest:
                 return pkts, ZKProtocolError('BAD_DECODE',
                     'Failed to decode Response: ValueError: reply xid '
                     '%d matches no request' % (xid,))
+            zxid = ((zhis[f] & 0xFFFFFFFF) << 32) | (zlos[f] & 0xFFFFFFFF)
+            if zxid >= 1 << 63:
+                zxid -= 1 << 64
             pkt = {
                 'xid': xid,
-                'zxid': i64pair_to_int(st.zxid_hi[i, f],
-                                       st.zxid_lo[i, f]),
-                'err': err_name(int(st.errs[i, f])),
+                'zxid': zxid,
+                'err': err_name(errs[f]),
                 'opcode': opcode,
             }
             if pkt['err'] == 'OK' and opcode not in _EMPTY_RESPONSES:
@@ -466,6 +623,29 @@ class FleetIngest:
                     err.__cause__ = e
                     return pkts, err
             pkts.append(pkt)
+        return pkts, None
+
+    def _assemble_ext(self, conn, buf, st, ext, i: int):
+        """C fast path for ``body_mode='host'``: decode stream ``i``'s
+        device-delimited complete-frame slice in one zero-copy pass of
+        the C-extension decoder — the same code the scalar drain runs,
+        so parity is by construction, at C speed.  The device scan
+        already proved the slice frame-complete and length-valid
+        (``bad`` streams took :meth:`_deliver_fallback`)."""
+        resid = int(st.resid[i])
+        if not resid:
+            return [], None
+        try:
+            pkts, _consumed, kind, msg = ext.decode_responses(
+                memoryview(buf)[:resid], conn.codec.xid_map, MAX_PACKET)
+        except Exception as e:
+            err = ZKProtocolError('BAD_DECODE',
+                'Failed to decode Response: %s: %s'
+                % (type(e).__name__, e))
+            err.__cause__ = e
+            return [], err
+        if kind is not None:
+            return pkts, ZKProtocolError(kind, msg)
         return pkts, None
 
     def _read_body(self, pkt, buf, st, bd, i: int, f: int) -> None:

@@ -144,6 +144,13 @@ class PacketCodec:
             self._ext = (native.ensure_ext() if use_native
                          else native.get_ext())
 
+    @property
+    def ext(self):
+        """The bound C-extension decoder (or None) — exposed for the
+        fleet ingest's zero-copy slice-decode fast path, which must
+        honor this connection's codec selection (``--codec``)."""
+        return self._ext
+
     def encode(self, pkt: dict) -> bytes:
         """Encode one outgoing packet to framed wire bytes."""
         if self._ext is not None and not self.handshaking:
