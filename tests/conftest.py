@@ -41,6 +41,12 @@ def server(event_loop):
     event_loop.run_until_complete(srv.stop())
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'timeout(seconds): per-test budget override for '
+        'the async runner (default 30 s)')
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests on the test's event_loop fixture."""
@@ -55,8 +61,10 @@ def pytest_pyfunc_call(pyfuncitem):
         kwargs = {name: pyfuncitem.funcargs[name]
                   for name in pyfuncitem._fixtureinfo.argnames
                   if name in pyfuncitem.funcargs}
+        mark = pyfuncitem.get_closest_marker('timeout')
+        budget = mark.args[0] if mark else 30
         loop.run_until_complete(
-            asyncio.wait_for(pyfuncitem.obj(**kwargs), timeout=30))
+            asyncio.wait_for(pyfuncitem.obj(**kwargs), timeout=budget))
     finally:
         if own_loop:
             loop.run_until_complete(loop.shutdown_asyncgens())

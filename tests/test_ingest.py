@@ -78,6 +78,12 @@ async def _workload(c: Client) -> list:
 
 async def _run_mode(ingest: FleetIngest | None) -> list:
     srv = await ZKServer().start()
+    if ingest is not None:
+        # compile the tick program BEFORE any session exists: an
+        # inline compile (device-bodies takes ~10 s on this host)
+        # inside the first tick would block the loop past the session
+        # timeout and the workload's event waits
+        await ingest.prewarm(1)
     c = make_client(srv.port, ingest=ingest)
     try:
         await c.wait_connected(timeout=5)
@@ -101,7 +107,9 @@ async def test_ingest_semantics_match_scalar_drain():
     assert host == scalar
     assert host_ing.ticks > 0 and host_ing.frames_routed > 0
 
-    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=256,
+    # min_len=1024: one (B, L) bucket for every tick this workload
+    # can produce, so the single block-mode compile covers them all
+    dev_ing = FleetIngest(body_mode='device', max_frames=8, min_len=1024,
                           bypass_bytes=0, max_data=128, max_path=64,
                           warm='block')
     dev = await _run_mode(dev_ing)
@@ -145,8 +153,9 @@ async def test_ingest_device_fallbacks():
     fallback inside the device body mode, transparently."""
     ingest = FleetIngest(body_mode='device', max_frames=8, bypass_bytes=0,
                          max_data=8, max_path=8,  # force fallbacks
-                         warm='block')
+                         min_len=1024, warm='block')
     srv = await ZKServer().start()
+    await ingest.prewarm(1)   # compile before the session's clock runs
     c = make_client(srv.port, ingest=ingest)
     try:
         await c.wait_connected(timeout=5)
@@ -306,6 +315,8 @@ async def _corrupt_create_scenario(ingest: FleetIngest | None):
 
     srv = await asyncio.start_server(handler, '127.0.0.1', 0)
     port = srv.sockets[0].getsockname()[1]
+    if ingest is not None:
+        await ingest.prewarm(1)  # compile outside the session's clock
     c = make_client(port, ingest=ingest)
     try:
         await c.wait_connected(timeout=5)
@@ -345,6 +356,39 @@ async def test_ingest_host_placement():
         assert ingest.ticks > 0
         assert ingest._device is not None
         assert ingest._device.platform == 'cpu'
+    finally:
+        await c.close()
+        await srv.stop()
+
+
+async def test_ingest_device_list_bodies():
+    """Within the static bounds, children and ACL list replies assemble
+    from the tensor planes (no scalar fallback), matching the scalar
+    decode exactly; beyond the bounds they fall back per frame."""
+    ingest = FleetIngest(body_mode='device', max_frames=8,
+                         bypass_bytes=0, warm='block', min_len=1024,
+                         max_children=8, max_name=16)
+    srv = await ZKServer().start()
+    await ingest.prewarm(1)   # compile before the session's clock runs
+    c = make_client(srv.port, ingest=ingest)
+    try:
+        await c.wait_connected(timeout=5)
+        for i in range(5):
+            await c.create('/n%d' % i, b'')
+        before = ingest.body_fallbacks
+        children, stat = await c.list('/')
+        assert sorted(children) == ['n%d' % i for i in range(5)]
+        assert stat.numChildren == 5
+        acl = await c.get_acl('/n0')
+        assert acl and acl[0].id.scheme == 'world' \
+            and acl[0].id.id == 'anyone'
+        assert ingest.body_fallbacks == before  # device-served
+        # beyond max_children: falls back, same result
+        for i in range(5, 10):
+            await c.create('/n%d' % i, b'')
+        children, _stat = await c.list('/')
+        assert len(children) == 10
+        assert ingest.body_fallbacks > before
     finally:
         await c.close()
         await srv.stop()

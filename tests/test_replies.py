@@ -215,3 +215,149 @@ def test_variable_fields_clamped_to_frame():
     assert int(st.n_frames[0]) == 1
     assert int(bodies.npath_len[0, 0]) == 0
     assert not bool(bodies.npath_mask[0, 0].any())
+
+
+# -- list-shaped bodies (children / ACL): ops/replies.parse_list_bodies
+#    vs records.read_response (VERDICT r2 item 7) --
+
+from zkstream_tpu.ops.replies import parse_list_bodies  # noqa: E402
+from zkstream_tpu.protocol.consts import Perm  # noqa: E402
+from zkstream_tpu.protocol.records import ACL, Id  # noqa: E402
+
+MAX_CHILDREN = 8
+MAX_NAME = 24
+MAX_ACLS = 3
+MAX_SCHEME = 12
+MAX_ID = 20
+
+_SCHEMES = ('world', 'digest', 'ip', 'x' * (MAX_SCHEME + 4))
+
+
+def _rand_list_packet(rng: random.Random, xid: int):
+    """A random children/ACL reply; sometimes deliberately beyond the
+    device bounds (count or element width) to pin the fallback
+    boundary."""
+    kind = rng.choice(('GET_CHILDREN', 'GET_CHILDREN2', 'GET_ACL'))
+    pkt = {'xid': xid, 'zxid': rng.randrange(0, 1 << 62), 'err': 'OK',
+           'opcode': kind}
+    if kind == 'GET_ACL':
+        n = rng.randrange(0, MAX_ACLS + 2)
+        pkt['acl'] = [
+            ACL(Perm(rng.randrange(1, 32)),
+                Id(rng.choice(_SCHEMES),
+                   'u' * rng.randrange(0, MAX_ID + 4)))
+            for _ in range(n)]
+        pkt['stat'] = _rand_stat(rng)
+    else:
+        n = rng.randrange(0, MAX_CHILDREN + 3)
+        pkt['children'] = [
+            'c' * rng.randrange(0, MAX_NAME + 6) for _ in range(n)]
+        if kind == 'GET_CHILDREN2':
+            pkt['stat'] = _rand_stat(rng)
+    return pkt, kind
+
+
+def _fits_device(pkt) -> bool:
+    """Whether the device bounds cover this packet (the expected value
+    of ch_ok/acl_ok)."""
+    if pkt['opcode'] == 'GET_ACL':
+        return (len(pkt['acl']) <= MAX_ACLS
+                and all(len(a.id.scheme) <= MAX_SCHEME
+                        and len(a.id.id) <= MAX_ID
+                        for a in pkt['acl']))
+    return (len(pkt['children']) <= MAX_CHILDREN
+            and all(len(c) <= MAX_NAME for c in pkt['children']))
+
+
+@pytest.mark.parametrize('seed', [11, 12, 13])
+def test_batched_list_bodies_match_scalar(seed):
+    """Device children/ACL parse == scalar read_response wherever the
+    ok flag is set, and the ok flag is exactly the static-bounds
+    predicate (the fallback boundary)."""
+    rng = random.Random(seed)
+    n_streams, F = 8, 6
+    streams, pkts = [], []
+    for _b in range(n_streams):
+        raw, row = b'', []
+        for f in range(F):
+            pkt, _op = _rand_list_packet(rng, f + 1)
+            raw += _frame(pkt)
+            row.append(pkt)
+        streams.append(raw)
+        pkts.append(row)
+    L = max(len(s) for s in streams)
+    buf = np.zeros((n_streams, L), np.uint8)
+    lens = np.zeros((n_streams,), np.int32)
+    for i, s in enumerate(streams):
+        buf[i, :len(s)] = np.frombuffer(s, np.uint8)
+        lens[i] = len(s)
+
+    st = wire_pipeline_step(jnp.asarray(buf), jnp.asarray(lens),
+                            max_frames=F)
+    lb = _host(parse_list_bodies(
+        jnp.asarray(buf), st.starts, st.sizes,
+        max_children=MAX_CHILDREN, max_name=MAX_NAME,
+        max_acls=MAX_ACLS, max_scheme=MAX_SCHEME, max_id=MAX_ID))
+
+    for i in range(n_streams):
+        for f in range(F):
+            pkt = pkts[i][f]
+            fits = _fits_device(pkt)
+            if pkt['opcode'] == 'GET_ACL':
+                assert bool(lb.acl_ok[i, f]) == fits, (i, f, pkt)
+                if not fits:
+                    continue
+                cnt = int(lb.acl_count[i, f])
+                assert cnt == len(pkt['acl'])
+                got = [
+                    ACL(Perm(int(lb.acl_perms[i, f, k])),
+                        Id(bytes(lb.acl_scheme[i, f, k, :max(
+                            int(lb.acl_scheme_len[i, f, k]), 0)]
+                           ).decode(),
+                           bytes(lb.acl_id[i, f, k, :max(
+                               int(lb.acl_id_len[i, f, k]), 0)]
+                           ).decode()))
+                    for k in range(cnt)]
+                assert got == pkt['acl'], (i, f)
+                assert bool(lb.stat_after_acl.valid[i, f])
+                assert stat_from_planes(lb.stat_after_acl, i, f) \
+                    == pkt['stat']
+            else:
+                assert bool(lb.ch_ok[i, f]) == fits, (i, f, pkt)
+                if not fits:
+                    continue
+                cnt = int(lb.ch_count[i, f])
+                assert cnt == len(pkt['children'])
+                got = [
+                    bytes(lb.ch_bytes[i, f, k, :max(
+                        int(lb.ch_len[i, f, k]), 0)]).decode()
+                    for k in range(cnt)]
+                assert got == pkt['children'], (i, f)
+                if pkt['opcode'] == 'GET_CHILDREN2':
+                    assert bool(lb.stat_after_children.valid[i, f])
+                    assert stat_from_planes(
+                        lb.stat_after_children, i, f) == pkt['stat']
+
+
+def test_list_truncated_falls_out():
+    """A children list whose element length field points past the frame
+    is not ok on device (the scalar reader raises BAD_DECODE for it)."""
+    # count=2, first element fine, second element length 1000
+    body = struct.pack('>iqi', 5, 9, 0)
+    body += struct.pack('>i', 2)
+    body += struct.pack('>i', 3) + b'abc'
+    body += struct.pack('>i', 1000) + b'xy'
+    raw = struct.pack('>i', len(body)) + body
+    buf = np.zeros((1, 64), np.uint8)
+    buf[0, :len(raw)] = np.frombuffer(raw, np.uint8)
+    lens = np.asarray([len(raw)], np.int32)
+    st = wire_pipeline_step(jnp.asarray(buf), jnp.asarray(lens),
+                            max_frames=2)
+    lb = _host(parse_list_bodies(jnp.asarray(buf), st.starts, st.sizes,
+                                 max_children=4, max_name=8))
+    assert not bool(lb.ch_ok[0, 0])
+    # and the scalar reader indeed raises for the same bytes
+    r = JuteReader(body[16:])
+    with pytest.raises(Exception):
+        count = r.read_int()
+        [r.read_ustring() for _ in range(count)]

@@ -13,7 +13,8 @@ running process:
   exactly-once really fails them all);
 - the process's task set returns to baseline (no leaked asyncio tasks).
 
-Bounded: ~8 s of chaos inside the 30 s per-test harness budget.
+Bounded: ~8 s of chaos per variant inside a 75 s per-test budget (the
+ingest variants add XLA warm-up on this single-core host).
 """
 
 from __future__ import annotations
@@ -21,7 +22,10 @@ from __future__ import annotations
 import asyncio
 import random
 
+import pytest
+
 from zkstream_tpu import Client, CreateFlag, ZKError
+from zkstream_tpu.io.ingest import FleetIngest
 from zkstream_tpu.protocol.errors import (
     ZKNotConnectedError,
     ZKPingTimeoutError,
@@ -37,8 +41,44 @@ CHAOS_SECONDS = 8.0
 EXPECTED = (ZKError, ZKNotConnectedError, ZKProtocolError,
             ZKPingTimeoutError, asyncio.TimeoutError)
 
+#: Ingest configurations the soaks run under (VERDICT r2 item 6): the
+#: batched drain has the most novel failure surface (mid-tick
+#: teardown, take/restore_pending hand-off, bad-frame fallback,
+#: background-warm scalar deferral), so it soaks in both body modes
+#: with the bypass both disabled and at its production default.
+def _ingest_variants():
+    return {
+        'scalar': lambda: None,
+        'ingest-host': lambda: FleetIngest(
+            body_mode='host', max_frames=8, bypass_bytes=0,
+            min_len=1024),
+        # narrow device planes: the soak exercises lifecycle, not
+        # decode width, and the smaller program compiles ~3x faster
+        # (its background compiles would otherwise bleed core time
+        # into the following tests on this single-core host)
+        'ingest-device': lambda: FleetIngest(
+            body_mode='device', max_frames=8, bypass_bytes=0,
+            min_len=1024, max_data=64, max_path=32, max_children=4,
+            max_name=16, max_acls=2, max_scheme=8, max_id=16),
+        'ingest-bypass': lambda: FleetIngest(
+            body_mode='host', max_frames=8),  # default bypass
+    }
 
-async def test_chaos_soak():
+
+async def _prewarm(ingest: FleetIngest | None) -> None:
+    """Compile the buckets the soak's fleet will hit (warm stays
+    'background': a mid-soak miss must drain scalar, never block —
+    that path is part of what the soak exercises)."""
+    if ingest is None:
+        return
+    for n in (4, N_CLIENTS):
+        await ingest.prewarm(n)
+
+
+@pytest.mark.timeout(75)
+@pytest.mark.parametrize('variant', list(_ingest_variants()))
+async def test_chaos_soak(variant):
+    ingest = _ingest_variants()[variant]()
     loop = asyncio.get_event_loop()
     unhandled: list = []
     loop.set_exception_handler(
@@ -46,8 +86,10 @@ async def test_chaos_soak():
 
     baseline_tasks = len(asyncio.all_tasks(loop))
     srv = await ZKServer().start()
+    await _prewarm(ingest)
     clients = [Client(address='127.0.0.1', port=srv.port,
-                      session_timeout=8000) for _ in range(N_CLIENTS)]
+                      session_timeout=8000, ingest=ingest)
+               for _ in range(N_CLIENTS)]
     for c in clients:
         c.start()
     await asyncio.gather(*[c.wait_connected(timeout=10)
@@ -129,19 +171,25 @@ async def test_chaos_soak():
     assert len(leaked) <= baseline_tasks + 1, leaked
 
 
-async def test_chaos_soak_ensemble():
+@pytest.mark.timeout(75)
+@pytest.mark.parametrize('variant', ['scalar', 'ingest-host'])
+async def test_chaos_soak_ensemble(variant):
     """The failover composition under fire: clients spread over a
     3-member ensemble while backends are killed and restarted (never
     all at once). Sessions must migrate/resume, an ephemeral node must
     survive every kill its owner outlives, and the same global
-    invariants hold (no unhandled loop exceptions, no task leak)."""
+    invariants hold (no unhandled loop exceptions, no task leak) —
+    including with the fleet's receive path on the batched drain."""
+    ingest = _ingest_variants()[variant]()
     loop = asyncio.get_event_loop()
     unhandled: list = []
     loop.set_exception_handler(lambda l, ctx: unhandled.append(ctx))
     baseline_tasks = len(asyncio.all_tasks(loop))
 
     ens = await ZKEnsemble(3).start()
-    clients = [Client(servers=ens.addresses(), session_timeout=8000)
+    await _prewarm(ingest)
+    clients = [Client(servers=ens.addresses(), session_timeout=8000,
+                      ingest=ingest)
                for _ in range(6)]
     for c in clients:
         c.start()

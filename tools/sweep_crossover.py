@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 GETS_TOTAL = 2048        # total get ops per cell, split over the fleet
 STORMS = 5               # fan-out storms per cell
+MAX_FRAMES = 16          # ingest per-stream frame bound (--max-frames)
 
 
 def _pct(xs, p):
@@ -51,11 +52,11 @@ async def run_cell(mode: str, n_conns: int) -> dict:
     kw: dict = {}
     if mode == 'ingest':
         from zkstream_tpu.io.ingest import FleetIngest
-        ingest = FleetIngest(body_mode='host', max_frames=16,
+        ingest = FleetIngest(body_mode='host', max_frames=MAX_FRAMES,
                              bypass_bytes=0)
     elif mode == 'ingest-py':
         from zkstream_tpu.io.ingest import FleetIngest
-        ingest = FleetIngest(body_mode='host', max_frames=16,
+        ingest = FleetIngest(body_mode='host', max_frames=MAX_FRAMES,
                              bypass_bytes=0)
         kw['use_native_codec'] = False
     elif mode == 'native':
@@ -152,20 +153,40 @@ async def run_cell(mode: str, n_conns: int) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument('--conns', default='32,128,256,512')
+    ap.add_argument('--conns', default='32,64,128,256,512')
     ap.add_argument('--modes', default='python,native,ingest,ingest-py')
+    ap.add_argument('--max-frames', type=int, default=16)
+    ap.add_argument('--rounds', type=int, default=3,
+                    help='interleaved rounds per cell; best get-ops '
+                         'round is reported (single-core scheduling '
+                         'noise swings single runs +-30%%)')
     args = ap.parse_args()
+    global MAX_FRAMES
+    MAX_FRAMES = args.max_frames
     conns = [int(x) for x in args.conns.split(',')]
     modes = args.modes.split(',')
+    best: dict = {}
+    for rnd in range(args.rounds):
+        for n in conns:
+            for mode in modes:
+                t0 = time.time()
+                try:
+                    r = asyncio.run(run_cell(mode, n))
+                except Exception as e:
+                    r = {'mode': mode, 'conns': n, 'error': repr(e)}
+                r['cell_s'] = round(time.time() - t0, 1)
+                r['round'] = rnd
+                print('#', json.dumps(r), flush=True)
+                key = (mode, n)
+                if 'error' in r:
+                    best.setdefault(key, r)
+                elif (key not in best or 'error' in best[key]
+                        or r['get']['ops_per_sec']
+                        > best[key]['get']['ops_per_sec']):
+                    best[key] = r
     for n in conns:
         for mode in modes:
-            t0 = time.time()
-            try:
-                r = asyncio.run(run_cell(mode, n))
-            except Exception as e:
-                r = {'mode': mode, 'conns': n, 'error': repr(e)}
-            r['cell_s'] = round(time.time() - t0, 1)
-            print(json.dumps(r), flush=True)
+            print(json.dumps(best[(mode, n)]), flush=True)
 
 
 if __name__ == '__main__':

@@ -112,9 +112,12 @@ class FleetIngest:
 
     def __init__(self, max_frames: int = 32, body_mode: str = 'host',
                  max_data: int = 256, max_path: int = 256,
+                 max_children: int = 16, max_name: int = 64,
+                 max_acls: int = 4, max_scheme: int = 16,
+                 max_id: int = 64,
                  min_len: int = 256, placement: str = 'auto',
                  latency_budget_ms: float = 5.0,
-                 bypass_bytes: int = 32768,
+                 bypass_bytes: int = 16384,
                  warm: str = 'background',
                  log: Logger | None = None):
         assert body_mode in ('host', 'device'), body_mode
@@ -124,6 +127,14 @@ class FleetIngest:
         self.body_mode = body_mode
         self.max_data = max_data
         self.max_path = max_path
+        #: bounds for the device list parse (children / ACL replies,
+        #: ops/replies.parse_list_bodies); longer lists fall back to
+        #: the scalar reader per frame
+        self.max_children = max_children
+        self.max_name = max_name
+        self.max_acls = max_acls
+        self.max_scheme = max_scheme
+        self.max_id = max_id
         self.min_len = min_len
         self.warm = warm
         #: Small-tick crossover: when a tick holds fewer than this many
@@ -132,8 +143,11 @@ class FleetIngest:
         #: through its connection's own scalar codec (C-accelerated
         #: when built) instead — identical observable semantics, the
         #: scalar path being the spec.  0 forces every tick onto the
-        #: device pipeline (tests, benchmarks).  The default is
-        #: calibrated from the measured crossover sweep (CROSSOVER.md).
+        #: device pipeline (tests, benchmarks).  Default 16 KiB = the
+        #: measured parity point (~128 connections x ~135 B frames,
+        #: CROSSOVER.md): below it the scalar drain wins outright;
+        #: above it the device path is free e2e and adds the stats
+        #: plane + device bodies + offload.
         self.bypass_bytes = bypass_bytes
         #: Where the tick's XLA program runs.  A tick is latency-bound
         #: (one dispatch + one readback inside the event loop), so
@@ -159,6 +173,9 @@ class FleetIngest:
         self.ticks_scalar = 0
         self.ticks_warming = 0
         self.frames_routed = 0
+        #: device-body mode: frames whose body needed the scalar
+        #: reader (oversized/list-overflow/malformed)
+        self.body_fallbacks = 0
         self._fns: dict = {}
         #: (device_bodies, Bp, L) -> AOT executable (None = compile
         #: failed; that bucket stays on the scalar drain)
@@ -203,10 +220,102 @@ class FleetIngest:
     # (n_frames, resid, bad) come first, then these [B, F] planes.
     _HDR_PLANES = ('starts', 'sizes', 'xids', 'errs',
                    'zxid_hi', 'zxid_lo')
-    # ReplyBodies int planes appended in device mode (Stat planes are
-    # flattened via StatPlanes._fields).
-    _BD_PLANES = ('data_len', 'str0_len', 'ntype', 'nstate',
-                  'npath_len', 'data_ok', 'str0_ok', 'npath_ok')
+
+    def _body_schema(self):
+        """Declarative layout of the device-body planes inside the
+        packed tick output — one source of truth for the device-side
+        pack and the host-side unpack.  Entry kinds:
+
+        - ``('plane', name)``: one int32 [B, F] plane;
+        - ``('multi', name, K)``: an int32 [B, F, K] tensor as K planes;
+        - ``('stat', name)``: a StatPlanes (one plane per field).
+        """
+        K, A = self.max_children, self.max_acls
+        return (
+            ('stat', 'stat0'), ('stat', 'stat_after_data'),
+            ('plane', 'data_len'), ('plane', 'str0_len'),
+            ('plane', 'ntype'), ('plane', 'nstate'),
+            ('plane', 'npath_len'), ('plane', 'data_ok'),
+            ('plane', 'str0_ok'), ('plane', 'npath_ok'),
+            ('plane', 'ch_count'), ('plane', 'ch_ok'),
+            ('multi', 'ch_len', K),
+            ('stat', 'stat_after_children'),
+            ('plane', 'acl_count'), ('plane', 'acl_ok'),
+            ('multi', 'acl_perms', A),
+            ('multi', 'acl_scheme_len', A),
+            ('multi', 'acl_id_len', A),
+            ('stat', 'stat_after_acl'),
+        )
+
+    def _bytes_schema(self):
+        """Widths of the uint8 [B, F, w] segments concatenated into the
+        packed byte plane (4-d sources flatten their trailing axes)."""
+        return (
+            ('data', self.max_data),
+            ('str0', self.max_path),
+            ('npath', self.max_path),
+            ('ch_bytes', self.max_children * self.max_name),
+            ('acl_scheme', self.max_acls * self.max_scheme),
+            ('acl_id', self.max_acls * self.max_id),
+        )
+
+    def _trace_step(self, buf, lens, device_bodies: bool):
+        """The traced tick computation: decode ``buf``/``lens`` and
+        pack the results into (ints, byts-or-None).  Pure array code —
+        jitted directly here, re-wrapped in ``shard_map`` by the
+        mesh-aware subclass (parallel/fleet.py)."""
+        import jax.numpy as jnp
+
+        from ..ops.pipeline import wire_pipeline_step
+        from ..ops.replies import (
+            StatPlanes,
+            parse_list_bodies,
+            parse_reply_bodies,
+        )
+
+        st = wire_pipeline_step(buf, lens, max_frames=self.max_frames)
+
+        def pack_ints(extra=()):
+            head = jnp.stack(
+                [st.n_frames, st.resid,
+                 st.bad.astype(jnp.int32)], axis=1)     # [B, 3]
+            planes = [getattr(st, f) for f in self._HDR_PLANES]
+            planes += list(extra)
+            flat = jnp.stack(planes, axis=1)            # [B, K, F]
+            B = flat.shape[0]
+            return jnp.concatenate([head, flat.reshape(B, -1)], axis=1)
+
+        if not device_bodies:
+            return st, pack_ints(), None
+        bd = parse_reply_bodies(
+            buf, st.starts, st.sizes,
+            max_data=self.max_data, max_path=self.max_path)
+        lb = parse_list_bodies(
+            buf, st.starts, st.sizes,
+            max_children=self.max_children, max_name=self.max_name,
+            max_acls=self.max_acls, max_scheme=self.max_scheme,
+            max_id=self.max_id)
+
+        def src(name):
+            v = getattr(bd, name, None)
+            return v if v is not None else getattr(lb, name)
+
+        extra = []
+        for ent in self._body_schema():
+            if ent[0] == 'plane':
+                extra.append(src(ent[1]).astype(jnp.int32))
+            elif ent[0] == 'multi':
+                t = src(ent[1]).astype(jnp.int32)
+                extra += [t[:, :, k] for k in range(ent[2])]
+            else:
+                sp = src(ent[1])
+                extra += [getattr(sp, f).astype(jnp.int32)
+                          for f in StatPlanes._fields]
+        B = buf.shape[0]
+        byts = jnp.concatenate(
+            [src(name).reshape(B, self.max_frames, -1)
+             for name, _w in self._bytes_schema()], axis=2)
+        return st, pack_ints(extra), byts
 
     def _step_fn(self, device_bodies: bool):
         """Build (and cache) the jittable one-dispatch decode for this
@@ -222,47 +331,16 @@ class FleetIngest:
         fn = self._fns.get(key)
         if fn is None:
             import jax
-            import jax.numpy as jnp
-
-            from ..ops.pipeline import wire_pipeline_step
-            from ..ops.replies import StatPlanes, parse_reply_bodies
-
-            def pack_ints(st, extra=()):
-                head = jnp.stack(
-                    [st.n_frames, st.resid,
-                     st.bad.astype(jnp.int32)], axis=1)     # [B, 3]
-                planes = [getattr(st, f) for f in self._HDR_PLANES]
-                planes += list(extra)
-                flat = jnp.stack(planes, axis=1)            # [B, K, F]
-                B = flat.shape[0]
-                return jnp.concatenate(
-                    [head, flat.reshape(B, -1)], axis=1)
 
             if device_bodies:
-                def step(buf, lens, max_frames, max_data, max_path):
-                    st = wire_pipeline_step(buf, lens,
-                                            max_frames=max_frames)
-                    bd = parse_reply_bodies(buf, st.starts, st.sizes,
-                                            max_data=max_data,
-                                            max_path=max_path)
-                    extra = []
-                    for sp in (bd.stat0, bd.stat_after_data):
-                        extra += [getattr(sp, f).astype(jnp.int32)
-                                  for f in StatPlanes._fields]
-                    extra += [getattr(bd, f).astype(jnp.int32)
-                              for f in self._BD_PLANES]
-                    ints = pack_ints(st, extra)
-                    byts = jnp.concatenate(
-                        [bd.data, bd.str0, bd.npath], axis=2)
+                def step(buf, lens):
+                    _st, ints, byts = self._trace_step(buf, lens, True)
                     return ints, byts
-                fn = jax.jit(step, static_argnames=(
-                    'max_frames', 'max_data', 'max_path'))
             else:
-                def step(buf, lens, max_frames):
-                    return pack_ints(
-                        wire_pipeline_step(buf, lens,
-                                           max_frames=max_frames))
-                fn = jax.jit(step, static_argnames=('max_frames',))
+                def step(buf, lens):
+                    _st, ints, _n = self._trace_step(buf, lens, False)
+                    return ints
+            fn = jax.jit(step)
             self._fns[key] = fn
         return fn
 
@@ -288,15 +366,7 @@ class FleetIngest:
         ctx = (jax.default_device(self._device) if self._device is not
                None else contextlib.nullcontext())
         with ctx:
-            if device_bodies:
-                lowered = fn.lower(batch, lens,
-                                   max_frames=self.max_frames,
-                                   max_data=self.max_data,
-                                   max_path=self.max_path)
-            else:
-                lowered = fn.lower(batch, lens,
-                                   max_frames=self.max_frames)
-            return lowered.compile()
+            return fn.lower(batch, lens).compile()
 
     def _try_compile(self, key: tuple):
         """Compile ``key``'s bucket; a failure logs and returns None
@@ -421,7 +491,8 @@ class FleetIngest:
 
     def _unpack(self, ints, byts):
         """Rebuild the host-side stat/body views from the packed
-        arrays (numpy views, no copies)."""
+        arrays (numpy views, no copies), walking the same schema the
+        device-side pack wrote."""
         import types
 
         from ..ops.replies import StatPlanes
@@ -429,32 +500,37 @@ class FleetIngest:
         B = ints.shape[0]
         F = self.max_frames
         head, flat = ints[:, :3], ints[:, 3:].reshape(B, -1, F)
-        fields = dict(n_frames=head[:, 0], resid=head[:, 1],
-                      bad=head[:, 2])
-        names = list(self._HDR_PLANES)
-        if byts is not None:
-            names += ['stat0.' + f for f in StatPlanes._fields]
-            names += ['stat_after_data.' + f for f in StatPlanes._fields]
-            names += list(self._BD_PLANES)
-        for k, name in enumerate(names):
-            fields[name] = flat[:, k]
-        st = types.SimpleNamespace(**{
-            k: v for k, v in fields.items() if '.' not in k})
-        bd = None
-        if byts is not None:
-            def stat(prefix):
-                vals = {f: fields[prefix + '.' + f]
-                        for f in StatPlanes._fields}
+        st = types.SimpleNamespace(n_frames=head[:, 0],
+                                   resid=head[:, 1], bad=head[:, 2])
+        k = 0
+        for name in self._HDR_PLANES:
+            setattr(st, name, flat[:, k])
+            k += 1
+        if byts is None:
+            return st, None
+
+        bd = types.SimpleNamespace()
+        for ent in self._body_schema():
+            if ent[0] == 'plane':
+                setattr(bd, ent[1], flat[:, k])
+                k += 1
+            elif ent[0] == 'multi':
+                K = ent[2]
+                # K consecutive planes -> a [B, F, K] view
+                setattr(bd, ent[1],
+                        np.moveaxis(flat[:, k:k + K], 1, 2))
+                k += K
+            else:
+                vals = {}
+                for f in StatPlanes._fields:
+                    vals[f] = flat[:, k]
+                    k += 1
                 vals['valid'] = vals['valid'].astype(bool)
-                return StatPlanes(**vals)
-            bd = types.SimpleNamespace(
-                stat0=stat('stat0'),
-                stat_after_data=stat('stat_after_data'),
-                data=byts[:, :, :self.max_data],
-                str0=byts[:, :, self.max_data:
-                          self.max_data + self.max_path],
-                npath=byts[:, :, self.max_data + self.max_path:],
-                **{f: fields[f] for f in self._BD_PLANES})
+                setattr(bd, ent[1], StatPlanes(**vals))
+        off = 0
+        for name, w in self._bytes_schema():
+            setattr(bd, name, byts[:, :, off:off + w])
+            off += w
         return st, bd
 
     def _tick(self) -> None:
@@ -654,6 +730,7 @@ class FleetIngest:
         if bd is not None:
             if self._read_body_device(pkt, bd, i, f):
                 return
+            self.body_fallbacks += 1
         # Scalar reader positioned at the device-located body offset.
         start = int(st.starts[i, f])
         size = int(st.sizes[i, f])
@@ -700,4 +777,41 @@ class FleetIngest:
             pkt['state'] = KeeperState(int(bd.nstate[i, f])).name
             pkt['path'] = bytes(bd.npath[i, f, :max(plen, 0)]).decode()
             return True
-        return False  # children / ACL lists: scalar reader
+        if opcode in ('GET_CHILDREN', 'GET_CHILDREN2'):
+            if not bool(bd.ch_ok[i, f]):
+                return False  # oversized/malformed list: scalar reader
+            if opcode == 'GET_CHILDREN2':
+                if not bool(bd.stat_after_children.valid[i, f]):
+                    return False  # truncated Stat: scalar raises
+                pkt['stat'] = stat_from_planes(
+                    bd.stat_after_children, i, f)
+            cnt = int(bd.ch_count[i, f])
+            lens = bd.ch_len[i, f, :cnt].tolist()
+            row, S = bd.ch_bytes[i, f], self.max_name
+            pkt['children'] = [
+                bytes(row[k * S:k * S + max(lens[k], 0)]).decode()
+                for k in range(cnt)]
+            return True
+        if opcode == 'GET_ACL':
+            if not bool(bd.acl_ok[i, f]) or \
+                    not bool(bd.stat_after_acl.valid[i, f]):
+                return False
+            from ..protocol.consts import Perm
+            from ..protocol.records import ACL, Id
+
+            cnt = int(bd.acl_count[i, f])
+            perms = bd.acl_perms[i, f, :cnt].tolist()
+            slens = bd.acl_scheme_len[i, f, :cnt].tolist()
+            ilens = bd.acl_id_len[i, f, :cnt].tolist()
+            srow, SS = bd.acl_scheme[i, f], self.max_scheme
+            irow, SI = bd.acl_id[i, f], self.max_id
+            pkt['acl'] = [
+                ACL(Perm(perms[k]), Id(
+                    bytes(srow[k * SS:k * SS + max(slens[k], 0)]
+                          ).decode(),
+                    bytes(irow[k * SI:k * SI + max(ilens[k], 0)]
+                          ).decode()))
+                for k in range(cnt)]
+            pkt['stat'] = stat_from_planes(bd.stat_after_acl, i, f)
+            return True
+        return False

@@ -228,6 +228,132 @@ def parse_reply_bodies(buf, starts, sizes, max_data: int = 128,
     )
 
 
+class ListBodies(NamedTuple):
+    """Speculative parse of the list-shaped reply bodies at every
+    frame — children lists (GET_CHILDREN / GET_CHILDREN2, reference:
+    lib/zk-buffer.js:337-347) and ACL lists (GET_ACL,
+    lib/zk-buffer.js:349-351,372-426) — bounded by static
+    (max_children, max_name) / (max_acls, max_scheme, max_id).
+
+    ``ch_ok`` / ``acl_ok`` mean the whole list fits the bounds AND lies
+    within the frame; a False slot must take the scalar fallback (which
+    either parses the oversized list or raises exactly the scalar
+    error).  Element lengths are the raw jute values (negative decodes
+    as empty, lib/jute-buffer.js:99-100)."""
+
+    ch_count: jnp.ndarray        # int32 [B, F]
+    ch_len: jnp.ndarray          # int32 [B, F, K] raw jute lengths
+    ch_bytes: jnp.ndarray        # uint8 [B, F, K, S]
+    ch_ok: jnp.ndarray           # bool [B, F]
+    stat_after_children: StatPlanes   # GET_CHILDREN2 trailing Stat
+    acl_count: jnp.ndarray       # int32 [B, F]
+    acl_perms: jnp.ndarray       # int32 [B, F, A]
+    acl_scheme_len: jnp.ndarray  # int32 [B, F, A]
+    acl_scheme: jnp.ndarray      # uint8 [B, F, A, SS]
+    acl_id_len: jnp.ndarray      # int32 [B, F, A]
+    acl_id: jnp.ndarray          # uint8 [B, F, A, SI]
+    acl_ok: jnp.ndarray          # bool [B, F]
+    stat_after_acl: StatPlanes   # GET_ACL trailing Stat
+
+
+def _scan_ustring(buf, cur, active, frame_end, max_len: int):
+    """One jute-string step of a sequential list walk: parse the
+    (int32 len, bytes) at ``cur`` where ``active``; an element is ok
+    when its extent fits the frame AND its length fits ``max_len``
+    (truncation is not an option for list elements — the whole frame
+    falls back instead).  Returns (raw_len, bytes, ok, next_cur)."""
+    at = jnp.where(active, cur, 0)
+    raw = jnp.where(active, be_i32_at(buf, at), 0)
+    n = jnp.maximum(raw, 0)
+    ok = active & (cur + 4 + n <= frame_end) & (n <= max_len)
+    data, _mask = slice_var_bytes(buf, cur + 4, jnp.where(ok, n, 0),
+                                  max_len)
+    return (jnp.where(ok, raw, 0), data, ok,
+            jnp.where(ok, cur + 4 + n, cur))
+
+
+def parse_list_bodies(buf, starts, sizes,
+                      max_children: int = 16, max_name: int = 64,
+                      max_acls: int = 4, max_scheme: int = 16,
+                      max_id: int = 64) -> ListBodies:
+    """Parse the children-list and ACL-list interpretations of every
+    frame (the bodies :func:`parse_reply_bodies` leaves to the scalar
+    reader).  Kept separate from it on purpose: the K x S byte gathers
+    are only worth paying when a consumer (the fleet ingest's device
+    body mode) actually routes list replies.
+
+    A list is a *sequential* layout — element k's offset depends on
+    every earlier length — so the walk is a short unrolled chain of
+    masked gathers (static ``max_children`` / ``max_acls`` steps), one
+    XLA program with no dynamic shapes.
+    """
+    from jax import lax
+
+    frame_ok = (starts >= 0) & (sizes >= REPLY_HDR)
+    start = jnp.where(frame_ok, starts, 0)
+    end = start + jnp.where(frame_ok, sizes, 0)
+    p = start + REPLY_HDR
+
+    have = frame_ok & (p + 4 <= end)
+    count = jnp.where(have, be_i32_at(buf, jnp.where(have, p, 0)), 0)
+
+    # -- children: count, then count x ustring.  The walk is
+    # sequential (element k's offset depends on every earlier length),
+    # so it is a lax.scan over the static element bound — the step
+    # traces once, keeping the compiled program small --
+    def ch_step(carry, k):
+        cur, ok = carry
+        active = ok & (k < count)
+        raw, data, elem_ok, cur = _scan_ustring(
+            buf, cur, active, end, max_name)
+        return (cur, ok & (~active | elem_ok)), (raw, data)
+
+    in_bounds = have & (count >= 0) & (count <= max_children)
+    (cur, ok), (ch_len, ch_bytes) = lax.scan(
+        ch_step, (p + 4, in_bounds),
+        jnp.arange(max_children, dtype=jnp.int32))
+    ch_len = jnp.moveaxis(ch_len, 0, 2)            # [B, F, K]
+    ch_bytes = jnp.moveaxis(ch_bytes, 0, 2)        # [B, F, K, S]
+    stat_after_children = parse_stats(
+        buf, cur, ok & (cur + STAT_WIRE <= end))
+
+    # -- ACL: count, then count x (perms:int32, scheme, id) --
+    def acl_step(carry, k):
+        cur, aok = carry
+        active = aok & (k < count)
+        at = jnp.where(active, cur, 0)
+        pm_ok = active & (cur + 4 <= end)
+        pm = jnp.where(pm_ok, be_i32_at(buf, at), 0)
+        cur = jnp.where(pm_ok, cur + 4, cur)
+        sraw, sdata, s_ok, cur = _scan_ustring(
+            buf, cur, pm_ok, end, max_scheme)
+        iraw, idata, i_ok, cur = _scan_ustring(
+            buf, cur, s_ok, end, max_id)
+        aok = aok & (~active | (pm_ok & s_ok & i_ok))
+        return (cur, aok), (pm, sraw, sdata, iraw, idata)
+
+    a_in = have & (count >= 0) & (count <= max_acls)
+    (acur, aok), (perms, slens, sbts, ilens, ibts) = lax.scan(
+        acl_step, (p + 4, a_in),
+        jnp.arange(max_acls, dtype=jnp.int32))
+    stat_after_acl = parse_stats(
+        buf, acur, aok & (acur + STAT_WIRE <= end))
+
+    return ListBodies(
+        ch_count=jnp.where(ok, count, 0),
+        ch_len=ch_len, ch_bytes=ch_bytes, ch_ok=ok,
+        stat_after_children=stat_after_children,
+        acl_count=jnp.where(aok, count, 0),
+        acl_perms=jnp.moveaxis(perms, 0, 2),
+        acl_scheme_len=jnp.moveaxis(slens, 0, 2),
+        acl_scheme=jnp.moveaxis(sbts, 0, 2),
+        acl_id_len=jnp.moveaxis(ilens, 0, 2),
+        acl_id=jnp.moveaxis(ibts, 0, 2),
+        acl_ok=aok,
+        stat_after_acl=stat_after_acl,
+    )
+
+
 # -- host-side views (numpy in, dataclasses out) --
 
 def stat_from_planes(planes, b: int, f: int):

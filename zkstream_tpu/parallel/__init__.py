@@ -13,13 +13,16 @@ the frame cursor across shard boundaries.
 - :mod:`sharded` — ``shard_map`` batched decode + collective reductions.
 - :mod:`seqscan` — byte-axis sequence-parallel frame scan (ring
   cursor hand-off via ``ppermute``).
+- :mod:`fleet` — :class:`MeshFleetIngest`, the runtime consumer: a
+  live connection fleet's per-tick decode dp-sharded over the mesh.
 """
 
+from .fleet import MeshFleetIngest
 from .mesh import make_mesh
 from .multihost import host_local_wire_batch, initialize
 from .sharded import sharded_wire_roundtrip, sharded_wire_step
 from .seqscan import seq_parallel_frame_scan
 
-__all__ = ['host_local_wire_batch', 'initialize', 'make_mesh',
-           'sharded_wire_roundtrip', 'sharded_wire_step',
+__all__ = ['MeshFleetIngest', 'host_local_wire_batch', 'initialize',
+           'make_mesh', 'sharded_wire_roundtrip', 'sharded_wire_step',
            'seq_parallel_frame_scan']

@@ -1,0 +1,125 @@
+"""Mesh-aware fleet ingest: the runtime consumer of the sharded plane.
+
+:class:`zkstream_tpu.io.ingest.FleetIngest` batches a live connection
+fleet's receive streams into one decode dispatch per event-loop tick;
+this subclass runs that tick's program **dp-sharded over a device
+mesh** via ``shard_map`` — the runtime twin of
+:func:`zkstream_tpu.parallel.sharded.sharded_wire_step` (which is the
+tested unit) — and reduces fleet-global session statistics with XLA
+collectives on the way:
+
+- per-stream planes stay ``P('dp', None)``-sharded end to end: each
+  device decodes the connections of its shard, and the host reads back
+  one packed array exactly as in the single-device ingest;
+- the fleet-wide reductions — total frames / replies / notifications /
+  pings / errors and the **fleet max zxid** (the resume checkpoint a
+  multi-host session manager persists, the distributed analogue of
+  lib/zk-session.js:229-235) — run as ``psum`` / unsigned-64 ``pmax``
+  collectives over the ``dp`` axis inside the same dispatch, and ride
+  back appended to the packed array: zero extra readbacks.
+
+On a multi-host pod slice the same class works over a global mesh with
+per-host connection slots (see parallel/multihost.py); the integration
+tests drive it on the virtual 8-device CPU mesh with live in-process
+connections (tests/test_mesh_ingest.py), and ``__graft_entry__``'s
+``dryrun_multichip`` executes it as part of the driver's multi-chip
+validation.
+"""
+
+from __future__ import annotations
+
+from ..io.ingest import FleetIngest
+from ..ops.bytesops import i64pair_to_int
+from .mesh import make_mesh
+
+#: appended global columns: frames, replies, notifications, pings,
+#: errors, max_zxid_hi, max_zxid_lo
+_N_GLOBALS = 7
+
+
+class MeshFleetIngest(FleetIngest):
+    """FleetIngest whose tick program is dp-sharded over ``mesh``.
+
+    Args:
+      mesh: a ``(dp, sp)`` mesh (default: all devices on the dp axis).
+      **kw: forwarded to :class:`FleetIngest`.  ``bypass_bytes``
+        defaults to 0 here — a mesh proxy exists to run the device
+        plane, not to bypass it.
+    """
+
+    def __init__(self, mesh=None, **kw):
+        kw.setdefault('bypass_bytes', 0)
+        super().__init__(**kw)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        #: fleet-global stats of the LAST device tick (None before the
+        #: first); scalar/warming ticks do not update it.
+        self.global_stats: dict | None = None
+        #: running fleet-wide maximum zxid over all device ticks — the
+        #: checkpoint a proxy-level session manager would persist.
+        self.fleet_max_zxid = 0
+
+    # the mesh decides placement; the latency probe is meaningless here
+    def _resolve_placement(self) -> None:
+        self._placed = True
+
+    def _bucket(self, n_streams: int, nbytes: int) -> tuple:
+        dev, Bp, L = super()._bucket(n_streams, nbytes)
+        dp = self.mesh.shape['dp']
+        # the batch axis must divide over dp shards
+        Bp = max(Bp, dp)
+        Bp = ((Bp + dp - 1) // dp) * dp
+        return dev, Bp, L
+
+    def _step_fn(self, device_bodies: bool):
+        fn = self._fns.get(device_bodies)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.bytesops import u64pair_reduce_max
+        from .sharded import _u64_axis_max
+
+        def local(buf, lens):
+            st, ints, byts = self._trace_step(buf, lens, device_bodies)
+            lh, ll = u64pair_reduce_max(st.max_zxid_hi, st.max_zxid_lo)
+            gh, gl = _u64_axis_max(lh, ll, 'dp')
+            g = jnp.stack([
+                lax.psum(jnp.sum(st.n_frames), 'dp'),
+                lax.psum(jnp.sum(st.n_replies), 'dp'),
+                lax.psum(jnp.sum(st.n_notifications), 'dp'),
+                lax.psum(jnp.sum(st.n_pings), 'dp'),
+                lax.psum(jnp.sum(st.n_errors), 'dp'),
+                gh, gl])
+            # replicated globals ride appended to each local row: the
+            # packed readback stays one array, zero extra transfers
+            ints = jnp.concatenate(
+                [ints, jnp.broadcast_to(g, (ints.shape[0],
+                                            _N_GLOBALS))], axis=1)
+            return (ints, byts) if device_bodies else ints
+
+        out_specs = ((P('dp', None), P('dp', None, None))
+                     if device_bodies else P('dp', None))
+        fn = jax.jit(shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P('dp', None), P('dp')),
+            out_specs=out_specs))
+        self._fns[device_bodies] = fn
+        return fn
+
+    def _unpack(self, ints, byts):
+        g = ints[0, -_N_GLOBALS:]
+        self.global_stats = {
+            'total_frames': int(g[0]),
+            'total_replies': int(g[1]),
+            'total_notifications': int(g[2]),
+            'total_pings': int(g[3]),
+            'total_errors': int(g[4]),
+            'max_zxid': i64pair_to_int(g[5], g[6]),
+        }
+        self.fleet_max_zxid = max(self.fleet_max_zxid,
+                                  self.global_stats['max_zxid'])
+        return super()._unpack(ints[:, :-_N_GLOBALS], byts)
