@@ -237,7 +237,7 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
 
     candidates = [
         ('pallas', lambda b, l: wire_pipeline_step_pallas(
-            b, l, max_frames=FRAMES, block_rows=128)),
+            b, l, max_frames=FRAMES, block_rows=64)),
         ('jnp', lambda b, l: wire_pipeline_step(
             b, l, max_frames=FRAMES)),
         ('full', full),

@@ -146,3 +146,53 @@ def test_vmem_guard_and_fallback(monkeypatch):
     out = wire_pipeline_step_pallas(buf, lens, max_frames=128,
                                     block_rows=128)
     assert int(out.n_frames.sum()) == 0
+
+
+def test_auto_dispatch_routes_by_platform_and_shape():
+    """wire_pipeline_step_auto picks the measured winner: jnp on
+    non-TPU platforms (this suite runs on the CPU backend) and inside
+    the recorded pocket only on TPU; the pocket predicate matches the
+    sweep table in PROFILE.md."""
+    from zkstream_tpu.ops.pipeline import (
+        _pallas_pocket,
+        _target_platform,
+        wire_pipeline_step,
+        wire_pipeline_step_auto,
+    )
+
+    # the recorded win pocket (tools/sweep_pallas.py)
+    assert _pallas_pocket(8192, 64)
+    assert not _pallas_pocket(8192, 8)       # frame-sparse: jnp
+    assert not _pallas_pocket(2048, 64)      # small fleet: jnp
+    assert not _pallas_pocket(32768, 64)     # tie band: jnp default
+
+    assert _target_platform() == 'cpu'       # forced by conftest
+    buf = np.zeros((8192, 256), np.uint8)
+    lens = np.zeros((8192,), np.int32)
+    auto = wire_pipeline_step_auto(buf, lens, max_frames=64)
+    ref = wire_pipeline_step(buf, lens, max_frames=64)
+    # on CPU the auto path IS the jnp path (pallas cannot lower here)
+    assert int(jnp.sum(auto.n_frames)) == int(jnp.sum(ref.n_frames))
+
+
+def test_auto_dispatch_honors_default_device_override():
+    """An active jax.default_device(cpu) override (how the fleet
+    ingest pins ticks to the host backend) routes auto-dispatch to
+    jnp even when the pocket matches."""
+    import jax
+
+    from zkstream_tpu.ops.pipeline import _target_platform
+
+    with jax.default_device(jax.devices('cpu')[0]):
+        assert _target_platform() == 'cpu'
+
+
+def test_target_platform_accepts_string_override():
+    """jax.default_device also accepts a platform string; the dispatch
+    probe must not assume a Device object."""
+    import jax
+
+    from zkstream_tpu.ops.pipeline import _target_platform
+
+    with jax.default_device('cpu'):
+        assert _target_platform() == 'cpu'

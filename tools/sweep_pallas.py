@@ -1,0 +1,113 @@
+"""Pallas-vs-jnp crossover sweep for the wire pipeline step.
+
+Times ``wire_pipeline_step_pallas`` (the fused Mosaic kernel) against
+``wire_pipeline_step`` (pure jnp/lax) across fleet shapes on the
+default JAX device (the real TPU under the driver), and prints one
+JSON line per cell — the measured basis for the shape-based
+auto-dispatch in ops/pipeline.py (VERDICT r2 item 3).
+
+No readback happens until every cell is timed: on a tunneled remote
+TPU the first readback permanently degrades dispatch, so correctness
+gates run at the end.
+
+Usage: python tools/sweep_pallas.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FRAME = 104           # 4-byte prefix + 16-byte header + 84-byte body
+REPEATS = 20
+
+
+def fleet(B: int, frames: int, seed: int = 7):
+    rng = np.random.RandomState(seed)
+    L = frames * FRAME
+    v = np.zeros((B, frames, FRAME), np.uint8)
+
+    def be(field, width, out):
+        shifts = np.arange(8 * (width - 1), -1, -8, dtype=np.int64)
+        out[...] = ((field[..., None] >> shifts) & 0xFF).astype(np.uint8)
+
+    be(np.full((B, frames), FRAME - 4, np.int64), 4, v[:, :, 0:4])
+    be(rng.randint(1, 1 << 20, (B, frames)).astype(np.int64), 4,
+       v[:, :, 4:8])
+    be(rng.randint(1, 1 << 40, (B, frames)).astype(np.int64), 8,
+       v[:, :, 8:16])
+    v[:, :, 20:] = rng.randint(0, 256, (B, frames, FRAME - 20),
+                               dtype=np.uint8)
+    return v.reshape(B, L), np.full((B,), L, np.int32)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    ap.add_argument('--block-rows', type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from zkstream_tpu.ops.pipeline import (
+        wire_pipeline_step,
+        wire_pipeline_step_pallas,
+    )
+
+    shapes = [(256, 8), (256, 64), (2048, 8), (2048, 64),
+              (8192, 64), (32768, 8), (32768, 64)]
+    if args.quick:
+        shapes = [(2048, 64), (32768, 64)]
+
+    cells = []
+    for B, F in shapes:
+        buf, lens = fleet(B, F)
+        jb, jl = jnp.asarray(buf), jnp.asarray(lens)
+        total = int(lens.sum())
+        row = {'B': B, 'frames': F, 'mib': round(total / 2**20, 1),
+               'backend': jax.default_backend()}
+        for name, fn in (
+                ('pallas', lambda b, l, F=F: wire_pipeline_step_pallas(
+                    b, l, max_frames=F, block_rows=args.block_rows)),
+                ('jnp', lambda b, l, F=F: wire_pipeline_step(
+                    b, l, max_frames=F))):
+            try:
+                step = jax.jit(fn)
+                out = step(jb, jl)
+                jax.block_until_ready(out)
+            except Exception as e:
+                row[name] = None
+                row[name + '_err'] = repr(e)[:80]
+                continue
+            dts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                leaves = [step(jb, jl).n_frames
+                          for _ in range(REPEATS)]
+                jax.block_until_ready(leaves)
+                dts.append((time.perf_counter() - t0) / REPEATS)
+            row[name] = round(total / min(dts) / 2**20, 0)
+            cells.append((row, name, out, B * F))
+        if row.get('pallas') and row.get('jnp'):
+            row['winner'] = ('pallas' if row['pallas'] > row['jnp']
+                             else 'jnp')
+            row['ratio'] = round(row['pallas'] / row['jnp'], 2)
+        print(json.dumps(row), flush=True)
+    # correctness gates last (readback poisons remote dispatch)
+    for row, name, out, want in cells:
+        got = int(np.asarray(out.n_frames).sum())
+        assert got == want, (row, name, got, want)
+    print('# all decode gates passed', file=sys.stderr)
+
+
+if __name__ == '__main__':
+    main()

@@ -266,14 +266,18 @@ class FleetIngest:
         mesh-aware subclass (parallel/fleet.py)."""
         import jax.numpy as jnp
 
-        from ..ops.pipeline import wire_pipeline_step
+        from ..ops.pipeline import wire_pipeline_step_auto
         from ..ops.replies import (
             StatPlanes,
             parse_list_bodies,
             parse_reply_bodies,
         )
 
-        st = wire_pipeline_step(buf, lens, max_frames=self.max_frames)
+        # auto-dispatch picks the measured winner for this shape and
+        # target platform (jnp on the host CPU backend; the Pallas
+        # kernel only in its recorded TPU win pocket — PROFILE.md)
+        st = wire_pipeline_step_auto(buf, lens,
+                                     max_frames=self.max_frames)
 
         def pack_ints(extra=()):
             head = jnp.stack(

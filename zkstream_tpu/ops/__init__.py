@@ -40,7 +40,11 @@ from .frame_scan import (
     frame_starts_pointer_doubling,
 )
 from .headers import parse_reply_headers, stream_stats
-from .pipeline import WireStats, wire_pipeline_step
+from .pipeline import (
+    WireStats,
+    wire_pipeline_step,
+    wire_pipeline_step_auto,
+)
 from .replies import (
     ReplyBodies,
     StatPlanes,
@@ -65,6 +69,7 @@ __all__ = [
     'stream_stats',
     'WireStats',
     'wire_pipeline_step',
+    'wire_pipeline_step_auto',
     'ReplyBodies',
     'StatPlanes',
     'parse_reply_bodies',

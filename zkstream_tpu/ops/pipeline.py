@@ -117,3 +117,42 @@ def wire_pipeline_step(buf, lens, max_frames: int = 32) -> WireStats:
         buf, lens, max_frames)
     headers = parse_reply_headers(buf, starts, sizes)
     return _assemble(headers, starts, sizes, counts, bad, resid)
+
+
+def _pallas_pocket(B: int, max_frames: int) -> bool:
+    """The shape region where the fused kernel measurably beats the
+    jnp pipeline on TPU v5e (PROFILE.md 'Pallas crossover study',
+    tools/sweep_pallas.py): frame-dense midsize fleets — at
+    (8192, 64) the kernel holds 1.20-1.24x across repeated interleaved
+    runs with block_rows=64.  Everywhere else the two are within the
+    ±10 % run-noise band or jnp wins (worst pallas cell: 0.78x at
+    (32768, 8)), so jnp is the default."""
+    return max_frames >= 32 and 4096 <= B <= 16384
+
+
+def _target_platform() -> str:
+    """The platform the caller's computation will actually lower to:
+    honors an active ``jax.default_device`` override (the fleet
+    ingest pins ticks to the host CPU backend this way) before falling
+    back to the default backend."""
+    import jax
+
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        # jax.default_device accepts a Device or a platform string
+        return dev if isinstance(dev, str) else dev.platform
+    return jax.default_backend()
+
+
+def wire_pipeline_step_auto(buf, lens, max_frames: int = 32) -> WireStats:
+    """Dispatch to the *measured* winner for this shape: the Pallas
+    kernel (block_rows=64) inside its recorded win pocket on TPU, the
+    jnp pipeline everywhere else — and on every non-TPU platform,
+    where Mosaic cannot lower.  The decision is trace-time (shapes are
+    static under jit); both paths are property-tested equivalent."""
+    if (_target_platform() == 'tpu'
+            and _pallas_pocket(buf.shape[0], max_frames)):
+        return wire_pipeline_step_pallas(buf, lens,
+                                         max_frames=max_frames,
+                                         block_rows=64)
+    return wire_pipeline_step(buf, lens, max_frames=max_frames)
