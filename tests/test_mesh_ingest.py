@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import asyncio
 
+import pytest
+
 from helpers import wait_until
 from zkstream_tpu import Client
 from zkstream_tpu.parallel import MeshFleetIngest, make_mesh
@@ -69,6 +71,38 @@ async def test_mesh_ingest_serves_live_fleet():
         assert ingest.fleet_max_zxid == max(
             c.session.last_zxid for c in clients)
         assert g['total_notifications'] >= 0
+    finally:
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()
+
+
+@pytest.mark.timeout(75)
+async def test_mesh_ingest_device_bodies():
+    """Device body mode composes with the mesh sharding: Stat/data and
+    children/ACL list bodies assemble from dp-sharded tensor planes."""
+    mesh = make_mesh(dp=8)
+    ingest = MeshFleetIngest(mesh=mesh, body_mode='device',
+                             max_frames=4, min_len=1024, warm='block',
+                             max_data=64, max_path=64,
+                             max_children=8, max_name=16)
+    srv = await ZKServer().start()
+    await ingest.prewarm(8)
+    clients = [make_client(srv.port, ingest) for _ in range(8)]
+    try:
+        await asyncio.gather(*[c.wait_connected(timeout=10)
+                               for c in clients])
+        for i, c in enumerate(clients):
+            await c.create('/b%d' % i, b'w%d' % i)
+        before = ingest.body_fallbacks
+        data, stat = await clients[2].get('/b2')
+        assert data == b'w2' and stat.version == 0
+        children, stat = await clients[0].list('/')
+        assert sorted(children) == ['b%d' % i for i in range(8)]
+        assert stat.numChildren == 8
+        acl = await clients[1].get_acl('/b1')
+        assert acl[0].id.scheme == 'world'
+        assert ingest.body_fallbacks == before  # all device-served
+        assert ingest.ticks > 0
     finally:
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()
