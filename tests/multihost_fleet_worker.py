@@ -1,0 +1,90 @@
+"""Worker process for the two-process multihost FLEET-PROXY test
+(run via subprocess by tests/test_multihost.py; not collected).
+
+Each process is one "host" of a 2-host cluster with 4 virtual CPU
+devices: it joins jax.distributed, starts its OWN in-process ZK server
+and 4 live clients, and serves them through one
+``MultihostFleetIngest`` over the GLOBAL 8-device mesh — every tick is
+a collective launch whose psum/pmax global stats cross the process
+boundary.  Both workers stop at the same coordinated launch count and
+print the fleet-global max zxid; the parent asserts the two processes
+read back the SAME global value (proof the reduction crossed DCN).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+STOP_AT = 600          # coordinated collective launch count
+LOCAL_CLIENTS = 4
+
+
+async def run(proc_id: int) -> None:
+    from zkstream_tpu import Client
+    from zkstream_tpu.parallel import MultihostFleetIngest, make_mesh
+    from zkstream_tpu.server import ZKServer
+
+    mesh = make_mesh(dp=8)          # global: 2 hosts x 4 devices
+    proxy = MultihostFleetIngest(
+        mesh=mesh, local_rows=LOCAL_CLIENTS, stream_len=2048,
+        tick_interval=0.01, body_mode='host', max_frames=4)
+    srv = await ZKServer().start()
+    # one aligned warm-up launch per host compiles the program before
+    # any session clock runs
+    proxy.warmup_tick()
+    clients = [Client(address='127.0.0.1', port=srv.port,
+                      ingest=proxy, session_timeout=30000)
+               for _ in range(LOCAL_CLIENTS)]
+    for c in clients:
+        c.start()
+    proxy.start()
+    await asyncio.gather(*[c.wait_connected(timeout=30)
+                           for c in clients])
+    for i, c in enumerate(clients):
+        path = await c.create('/p%d-%d' % (proc_id, i),
+                              b'h%d' % proc_id)
+        assert path == '/p%d-%d' % (proc_id, i)
+    for i, c in enumerate(clients):
+        data, stat = await c.get('/p%d-%d' % (proc_id, i))
+        assert data == b'h%d' % proc_id and stat.version == 0
+    assert proxy.ticks > 0
+    local_max = max(c.session.last_zxid for c in clients)
+    # let a few more collective ticks run so the global pmax has seen
+    # BOTH hosts' final zxids, then stop at the coordinated count
+    await asyncio.sleep(0.5)
+    assert proxy.tick_count < STOP_AT, (
+        'worker too slow: already past the coordinated stop count '
+        '(%d >= %d)' % (proxy.tick_count, STOP_AT))
+    await proxy.stop(after_ticks=STOP_AT)
+    assert proxy.fleet_max_zxid >= local_max
+    g = proxy.global_stats
+    assert g is not None
+    print('FLEETWORKER_OK %d fleet_max_zxid=%d' %
+          (proc_id, proxy.fleet_max_zxid), flush=True)
+    await asyncio.gather(*[c.close() for c in clients])
+    await srv.stop()
+
+
+def main() -> int:
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    coord = sys.argv[3]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from zkstream_tpu.utils.platform import force_cpu
+
+    force_cpu(n_devices=4)
+
+    from zkstream_tpu.parallel.multihost import initialize
+
+    initialize(coordinator_address=coord, num_processes=num_procs,
+               process_id=proc_id)
+    asyncio.run(run(proc_id))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -142,3 +142,59 @@ async def test_mesh_ingest_matches_single_device_ingest():
                                      body_mode='host', max_frames=4,
                                      min_len=1024, warm='block'))
     assert mesh == single
+
+
+@pytest.mark.timeout(75)
+async def test_multihost_fleet_ingest_single_process():
+    """The fixed-cadence multihost proxy (parallel/fleet.py
+    MultihostFleetIngest) in its single-process degenerate case: live
+    connections served by timer-driven, fixed-shape global dispatches
+    with carry-over past stream_len and capacity enforcement."""
+    from zkstream_tpu.parallel import MultihostFleetIngest
+
+    mesh = make_mesh(dp=8)
+    proxy = MultihostFleetIngest(mesh=mesh, local_rows=8,
+                                 stream_len=2048, tick_interval=0.005,
+                                 body_mode='host', max_frames=4)
+    srv = await ZKServer().start()
+    proxy.warmup_tick()       # compile the global program up front
+    clients = [make_client(srv.port, proxy) for _ in range(8)]
+    try:
+        proxy.start()
+        await asyncio.gather(*[c.wait_connected(timeout=10)
+                               for c in clients])
+        for i, c in enumerate(clients):
+            p = await c.create('/h%d' % i, b'x%d' % i)
+            assert p == '/h%d' % i
+        datas = await asyncio.gather(*[c.get('/h%d' % i)
+                                       for i, c in enumerate(clients)])
+        assert [d for d, _s in datas] == \
+            [b'x%d' % i for i in range(8)]
+        assert proxy.ticks > 0
+        g = proxy.global_stats
+        assert g is not None and g['total_frames'] > 0
+        assert proxy.fleet_max_zxid == max(
+            c.session.last_zxid for c in clients)
+        # a reply frame larger than stream_len can never fit the
+        # fixed-shape tick: the row escapes to the scalar drain
+        # instead of wedging
+        await clients[0].create('/big', b'z' * 4000)  # > stream_len
+        data, _stat = await clients[0].get('/big')
+        assert data == b'z' * 4000
+        # capacity is static: a 9th connection still works, served by
+        # the scalar drain (with a loud log), never a broken FSM
+        extra = Client(address='127.0.0.1', port=srv.port,
+                       ingest=proxy, session_timeout=5000)
+        extra.start()
+        await extra.wait_connected(timeout=10)
+        path = await extra.create('/overflow', b'ok')
+        assert path == '/overflow'
+        await extra.close()
+        # per-bucket prewarm is a trap here; the API says so
+        with pytest.raises(NotImplementedError):
+            await proxy.prewarm(8)
+    finally:
+        stop_at = proxy.tick_count + 1
+        await proxy.stop(after_ticks=stop_at)
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()

@@ -58,3 +58,47 @@ def test_two_process_sharded_wire_step():
     lines = [next(ln for ln in out.splitlines() if 'WORKER_OK' in ln)
              for out in outs]
     assert lines[0].split()[2:] == lines[1].split()[2:], lines
+
+
+FLEET_WORKER = os.path.join(os.path.dirname(__file__),
+                            'multihost_fleet_worker.py')
+
+
+def test_two_process_multihost_fleet_ingest():
+    """Two real processes, each serving its own live client fleet
+    through one globally sharded MultihostFleetIngest: the collective
+    tick cadence stays aligned, ops complete on both hosts, and both
+    read back the SAME fleet-global max zxid (the pmax crossed the
+    process boundary)."""
+    coord = '127.0.0.1:%d' % _free_port()
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env.pop('XLA_FLAGS', None)
+    env.pop('JAX_PLATFORMS', None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, FLEET_WORKER, str(pid), '2', coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, cwd=REPO, text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            'fleet worker %d failed (rc %s):\n%s'
+            % (pid, p.returncode, out))
+        assert 'FLEETWORKER_OK %d' % pid in out, out
+    # both hosts read back the same fleet-global pmax over DCN
+    vals = [next(ln for ln in out.splitlines()
+                 if 'FLEETWORKER_OK' in ln).split()[-1]
+            for out in outs]
+    assert vals[0] == vals[1], vals

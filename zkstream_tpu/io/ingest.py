@@ -598,29 +598,37 @@ class FleetIngest:
 
         retick = False
         for i, (conn, buf) in enumerate(active):
-            # A user callback from an earlier stream's delivery may
-            # have torn this connection down mid-tick (unregister
-            # already restored its bytes to the codec): skip it.
-            if id(conn) not in self._slots:
-                continue
-            n = int(st.n_frames[i])
-            if bool(st.bad[i]):
-                # Exact scalar-error parity: re-run this stream through
-                # the connection's own codec, which raises BAD_LENGTH/
-                # BAD_DECODE with the pre-error packets attached.
-                self._deliver_fallback(conn, buf)
-                continue
-            pkts, err = self._assemble_stream(conn, buf, st, bd, i, n)
-            resid = int(st.resid[i])
-            if resid:
-                del buf[:resid]
-            self.frames_routed += n
-            if err is None and n == self.max_frames and len(buf) >= 4:
-                retick = True  # more complete frames may be buffered
-            if pkts or err is not None:
-                conn.emit('ingestDeliver', pkts, err)
+            if self._route_stream(conn, buf, st, bd, i):
+                retick = True
         if retick:
             self._schedule()
+
+    def _route_stream(self, conn, buf, st, bd, i: int) -> bool:
+        """Deliver stream ``i``'s decoded tick results to its
+        connection (shared by the event-driven tick and the multihost
+        cadence tick).  Returns True when more complete frames may
+        still be buffered (the per-stream frame bound was hit)."""
+        # A user callback from an earlier stream's delivery may have
+        # torn this connection down mid-tick (unregister already
+        # restored its bytes to the codec): skip it.
+        if id(conn) not in self._slots:
+            return False
+        n = int(st.n_frames[i])
+        if bool(st.bad[i]):
+            # Exact scalar-error parity: re-run this stream through
+            # the connection's own codec, which raises BAD_LENGTH/
+            # BAD_DECODE with the pre-error packets attached.
+            self._deliver_fallback(conn, buf)
+            return False
+        pkts, err = self._assemble_stream(conn, buf, st, bd, i, n)
+        resid = int(st.resid[i])
+        if resid:
+            del buf[:resid]
+        self.frames_routed += n
+        if pkts or err is not None:
+            conn.emit('ingestDeliver', pkts, err)
+        return (err is None and n == self.max_frames
+                and len(buf) >= 4)
 
     def _deliver_scalar(self, conn: 'ZKConnection', buf: bytearray,
                         keep_stream: bool = True) -> None:

@@ -17,12 +17,13 @@ the frame cursor across shard boundaries.
   live connection fleet's per-tick decode dp-sharded over the mesh.
 """
 
-from .fleet import MeshFleetIngest
+from .fleet import MeshFleetIngest, MultihostFleetIngest
 from .mesh import make_mesh
 from .multihost import host_local_wire_batch, initialize
 from .sharded import sharded_wire_roundtrip, sharded_wire_step
 from .seqscan import seq_parallel_frame_scan
 
-__all__ = ['MeshFleetIngest', 'host_local_wire_batch', 'initialize',
+__all__ = ['MeshFleetIngest', 'MultihostFleetIngest',
+           'host_local_wire_batch', 'initialize',
            'make_mesh', 'sharded_wire_roundtrip', 'sharded_wire_step',
            'seq_parallel_frame_scan']

@@ -28,6 +28,8 @@ validation.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..io.ingest import FleetIngest
 from ..ops.bytesops import i64pair_to_int
 from .mesh import make_mesh
@@ -123,3 +125,221 @@ class MeshFleetIngest(FleetIngest):
         self.fleet_max_zxid = max(self.fleet_max_zxid,
                                   self.global_stats['max_zxid'])
         return super()._unpack(ints[:, :-_N_GLOBALS], byts)
+
+
+class MultihostFleetIngest(MeshFleetIngest):
+    """Multi-controller fleet proxy: every host of a pod slice serves
+    its own live connections through ONE globally sharded tick program.
+
+    The single-host ingest ticks when bytes arrive; that cannot work
+    multi-controller — a ``shard_map`` program over a global mesh is a
+    collective launch, so every process must launch the same program
+    the same number of times.  This class therefore runs on a **fixed
+    cadence with fixed shapes**:
+
+    - capacity is static: ``local_rows`` connection slots per host,
+      each up to ``stream_len`` buffered bytes per tick (a longer
+      backlog carries over — the decode consumes whole frames and
+      leaves the remainder buffered);
+    - a timer fires every ``tick_interval`` seconds and ALWAYS
+      dispatches, even with every slot empty (empty rows decode zero
+      frames) — no data-dependent control flow, so the SPMD launch
+      counts stay aligned across hosts with at most one interval of
+      skew;
+    - each host assembles only its own rows
+      (:func:`~zkstream_tpu.parallel.multihost.host_local_wire_batch`
+      — no cross-host stream bytes, ICI/DCN carries just the psum/pmax
+      scalars) and reads back only its addressable shards;
+    - the fleet-global stats (total frames, fleet max zxid — the
+      resume checkpoint of the WHOLE pod's session population) reduce
+      across all hosts inside the dispatch.
+
+    Lifecycle: ``start()`` begins the cadence; ``await
+    stop(after_ticks=N)`` stops once N total ticks have run — stopping
+    must be coordinated (same N everywhere), because a host that
+    stops launching strands the others' collectives; that is the
+    multi-controller contract, not a quirk of this class.
+
+    Driven two-process in tests/test_multihost.py
+    (multihost_fleet_worker.py) and single-process in
+    tests/test_mesh_ingest.py.
+    """
+
+    def __init__(self, mesh=None, local_rows: int = 8,
+                 stream_len: int = 4096,
+                 tick_interval: float = 0.005, **kw):
+        import jax
+
+        kw.setdefault('min_len', stream_len)
+        super().__init__(mesh=mesh, **kw)
+        dp = self.mesh.shape['dp']
+        global_rows = local_rows * jax.process_count()
+        if global_rows % dp:
+            raise ValueError(
+                'local_rows=%d x %d processes = %d global rows must '
+                'divide over the dp axis (%d)' %
+                (local_rows, jax.process_count(), global_rows, dp))
+        self.local_rows = local_rows
+        self.stream_len = stream_len
+        self.tick_interval = tick_interval
+        self.tick_count = 0
+        self._rows: dict[int, int] = {}       # id(conn) -> row
+        self._free = list(range(local_rows - 1, -1, -1))
+        self._timer = None
+        self._stop_at: int | None = None
+        self._warned_capacity = False
+
+    # event-driven scheduling is disabled: the cadence launches ticks
+    def _schedule(self) -> None:
+        pass
+
+    def register(self, conn) -> None:
+        # Never raise here: register runs inside the connection FSM's
+        # state-entry handler, and an exception there would strand a
+        # half-wired connection.  Overflow connections get no row —
+        # the cadence drains them through the scalar codec instead.
+        if self._free:
+            self._rows[id(conn)] = self._free.pop()
+        elif not self._warned_capacity:
+            self._warned_capacity = True
+            self.log.warning(
+                'MultihostFleetIngest capacity exceeded '
+                '(local_rows=%d); overflow connections are served by '
+                'the scalar drain — size the proxy for the host\'s '
+                'connection budget', self.local_rows)
+        super().register(conn)
+
+    def unregister(self, conn) -> None:
+        row = self._rows.pop(id(conn), None)
+        if row is not None:
+            self._free.append(row)
+        super().unregister(conn)
+
+    def start(self) -> None:
+        """Begin the tick cadence on the running loop."""
+        import asyncio
+
+        if self._timer is None:
+            self._timer = asyncio.get_running_loop().create_task(
+                self._cadence())
+
+    def warmup_tick(self) -> None:
+        """Run ONE aligned collective tick synchronously — call it the
+        same number of times on every host before ``start()`` to pay
+        the XLA compile outside any session's clock."""
+        self._mh_tick()
+
+    async def prewarm(self, n_streams: int,
+                      nbytes: int | None = None) -> None:
+        raise NotImplementedError(
+            'MultihostFleetIngest compiles one fixed-shape GLOBAL '
+            'program; use warmup_tick() — the same number of times on '
+            'every host — instead of the per-bucket prewarm')
+
+    async def stop(self, after_ticks: int | None = None) -> None:
+        """Stop the cadence.  With ``after_ticks`` (the coordinated
+        form — pass the SAME value on every host) the cadence runs out
+        to exactly that launch count and exits by itself, so every
+        process ends with identical collective launch counts; without
+        it the timer is cancelled immediately (single-process use)."""
+        import asyncio
+
+        if self._timer is None:
+            return
+        if after_ticks is not None:
+            if self.tick_count > after_ticks:
+                # the alignment contract is already broken — failing
+                # loudly beats stranding the other hosts' collectives
+                raise RuntimeError(
+                    'stop(after_ticks=%d) but %d ticks already ran; '
+                    'launch counts would diverge across hosts'
+                    % (after_ticks, self.tick_count))
+            self._stop_at = after_ticks
+            await self._timer
+        else:
+            self._timer.cancel()
+            try:
+                await self._timer
+            except asyncio.CancelledError:
+                pass
+        self._timer = None
+
+    async def _cadence(self) -> None:
+        import asyncio
+
+        while self._stop_at is None or self.tick_count < self._stop_at:
+            await asyncio.sleep(self.tick_interval)
+            try:
+                self._mh_tick()
+            except Exception:
+                # keep launching: a dead cadence on one host strands
+                # every other host's collectives (their readbacks
+                # block), turning one local error into a fleet-wide
+                # stall.  (An exception BEFORE the dispatch still
+                # skips a launch — unavoidable — but the common
+                # failures are host-side, after it.)
+                self.log.exception('multihost tick failed; '
+                                   'cadence continues')
+
+    def _local_view(self, arr):
+        """This process's rows of a dp-sharded global array, in row
+        order (the inverse of host_local_wire_batch's placement)."""
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards],
+                              axis=0)
+
+    def _mh_tick(self) -> None:
+        from .multihost import host_local_wire_batch
+
+        self.tick_count += 1
+        batch = np.zeros((self.local_rows, self.stream_len), np.uint8)
+        lens = np.zeros((self.local_rows,), np.int32)
+        active = {}
+        overflow = []
+        for cid, (conn, buf) in list(self._slots.items()):
+            if not buf or not conn.is_in_state('connected'):
+                continue
+            row = self._rows.get(cid)
+            if row is None:          # over capacity: scalar-drained
+                overflow.append((conn, buf))
+                continue
+            n = min(len(buf), self.stream_len)
+            batch[row, :n] = np.frombuffer(memoryview(buf)[:n],
+                                           np.uint8)
+            lens[row] = n
+            active[row] = (conn, buf)
+
+        device = self.body_mode == 'device'
+        fn = self._step_fn(device)
+        gbuf, glens = host_local_wire_batch(self.mesh, batch, lens)
+        # the launch itself is unconditional — collective alignment.
+        # Global stats read back on every tick (they carry the OTHER
+        # hosts' traffic too); the body planes only when this host has
+        # frames to route.
+        if device:
+            ints, byts = fn(gbuf, glens)
+            byts = self._local_view(byts) if active else None
+        else:
+            ints = fn(gbuf, glens)
+            byts = None
+        ints = self._local_view(ints)
+        st, bd = self._unpack(ints, byts)
+        for conn, buf in overflow:
+            if id(conn) in self._slots:
+                self._deliver_scalar(conn, buf)
+        if not active:
+            return
+        self.ticks += 1
+
+        for row, (conn, buf) in active.items():
+            if (int(st.n_frames[row]) == 0 and not bool(st.bad[row])
+                    and int(st.resid[row]) == 0
+                    and len(buf) >= self.stream_len):
+                # a single frame larger than stream_len can never fit
+                # a fixed-shape tick: drain this stream through the
+                # scalar codec (which has no length bound) instead of
+                # re-dispatching the same prefix forever
+                self._deliver_scalar(conn, buf)
+                continue
+            self._route_stream(conn, buf, st, bd, row)
