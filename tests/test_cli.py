@@ -155,7 +155,7 @@ def test_cli_server_spec_parsing(capsys):
 async def test_cli_codec_flag(server, capsys):
     """--codec native / python both serve a full get round trip; auto
     is the default (parser-level)."""
-    for codec in ('native', 'python'):
+    for codec in ('native', 'python', 'ingest'):
         rc, out, _ = await run_cli(server, '--codec', codec,
                                    'create', '/k-%s' % codec, 'v',
                                    capsys=capsys)

@@ -46,3 +46,45 @@ async def test_client_counts_events_and_notifications(server):
     notif = coll.get_collector('zookeeper_notifications')
     assert notif.value({'event': 'dataChanged'}) >= 1
     await c.close()
+
+
+async def test_ingest_gauges(server):
+    """FleetIngest binds pull-model gauges (device/scalar/warming
+    ticks, frames, body fallbacks) onto the collector; exposition
+    reads live values at scrape time."""
+    from zkstream_tpu import Client, Collector
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    col = Collector()
+    ingest = FleetIngest(body_mode='host', max_frames=8,
+                         bypass_bytes=0, warm='block')
+    ingest.bind_metrics(col)
+    assert 'zkstream_ingest_ticks 0' in col.expose()
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, ingest=ingest)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await ingest.prewarm(1)
+        await c.create('/g', b'v')
+        data, _stat = await c.get('/g')
+        assert data == b'v'
+        text = col.expose()
+        assert 'zkstream_ingest_ticks %d' % ingest.ticks in text
+        assert ingest.ticks > 0
+        assert 'zkstream_ingest_frames_routed %d' \
+            % ingest.frames_routed in text
+        assert '# TYPE zkstream_ingest_ticks gauge' in text
+    finally:
+        await c.close()
+
+
+def test_gauge_callback_failure_does_not_sink_exposition():
+    from zkstream_tpu import Collector
+
+    col = Collector()
+    col.gauge('ok_gauge', lambda: 7)
+    col.gauge('bad_gauge', lambda: 1 / 0)
+    text = col.expose()
+    assert 'ok_gauge 7' in text
+    assert 'bad_gauge nan' in text

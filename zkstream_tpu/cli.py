@@ -83,10 +83,16 @@ async def _run(args) -> int:
     addrs = ','.join('%s:%d' % (s['address'], s['port'])
                      for s in args.server)
     use_native = {'auto': None, 'native': True,
-                  'python': False}[args.codec]
+                  'python': False, 'ingest': None}[args.codec]
+    ingest = None
+    if args.codec == 'ingest':
+        # the batched device plane with its production defaults
+        # (measured bypass crossover, background warm) — CROSSOVER.md
+        from .io.ingest import FleetIngest
+        ingest = FleetIngest(body_mode='host')
     client = Client(servers=args.server,
                     session_timeout=args.session_timeout,
-                    use_native_codec=use_native)
+                    use_native_codec=use_native, ingest=ingest)
     client.start()
     try:
         try:
@@ -219,11 +225,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help='ZK session timeout, ms')
     p.add_argument('--timeout', type=float, default=10.0,
                    help='connect timeout, seconds')
-    p.add_argument('--codec', choices=('auto', 'native', 'python'),
+    p.add_argument('--codec',
+                   choices=('auto', 'native', 'python', 'ingest'),
                    default='auto',
                    help='receive decoder: the C extension when built '
                         '(native: require it; python: scalar codec; '
-                        'default auto)')
+                        'ingest: the batched device plane with its '
+                        'production crossover; default auto)')
     sub = p.add_subparsers(dest='cmd', required=True)
 
     sub.add_parser('ping', help='round-trip a ping')

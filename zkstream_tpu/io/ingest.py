@@ -416,6 +416,27 @@ class FleetIngest:
                          name='ingest-warm').start()
         return ev
 
+    def bind_metrics(self, collector) -> None:
+        """Expose this ingest's tick/frame counters as pull-model
+        gauges on ``collector`` (utils/metrics.Collector) — the
+        observability twin of the reference's artedi counters
+        (lib/client.js:29,58-61) for the batched plane."""
+        for name, attr, help_text in (
+                ('zkstream_ingest_ticks', 'ticks',
+                 'device ticks dispatched'),
+                ('zkstream_ingest_scalar_ticks', 'ticks_scalar',
+                 'ticks drained through the scalar codec (bypass or '
+                 'failed bucket)'),
+                ('zkstream_ingest_warming_ticks', 'ticks_warming',
+                 'ticks deferred to scalar while a shape bucket '
+                 'compiled'),
+                ('zkstream_ingest_frames_routed', 'frames_routed',
+                 'frames delivered through the ingest'),
+                ('zkstream_ingest_body_fallbacks', 'body_fallbacks',
+                 'device-body frames that needed the scalar reader')):
+            collector.gauge(name, (lambda a=attr: getattr(self, a)),
+                            help_text)
+
     async def prewarm(self, n_streams: int,
                       nbytes: int | None = None) -> None:
         """Compile the tick program for an expected fleet shape up

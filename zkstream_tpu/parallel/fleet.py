@@ -64,6 +64,14 @@ class MeshFleetIngest(FleetIngest):
     def _resolve_placement(self) -> None:
         self._placed = True
 
+    def bind_metrics(self, collector) -> None:
+        super().bind_metrics(collector)
+        collector.gauge(
+            'zkstream_fleet_max_zxid',
+            lambda: self.fleet_max_zxid,
+            'fleet-global max zxid (pmax over the mesh) — the '
+            'proxy-level session resume checkpoint')
+
     def _bucket(self, n_streams: int, nbytes: int) -> tuple:
         dev, Bp, L = super()._bucket(n_streams, nbytes)
         dp = self.mesh.shape['dp']
@@ -269,6 +277,12 @@ class MultihostFleetIngest(MeshFleetIngest):
 
         while self._stop_at is None or self.tick_count < self._stop_at:
             await asyncio.sleep(self.tick_interval)
+            if self._stop_at is not None \
+                    and self.tick_count >= self._stop_at:
+                # stop() landed mid-sleep after the loop check: one
+                # more tick here would exceed the coordinated launch
+                # count and strand the other hosts' collectives
+                break
             try:
                 self._mh_tick()
             except Exception:
@@ -333,6 +347,11 @@ class MultihostFleetIngest(MeshFleetIngest):
         self.ticks += 1
 
         for row, (conn, buf) in active.items():
+            # an earlier row's delivery callback may have torn this
+            # connection down mid-tick (unregister already restored
+            # its bytes to the codec)
+            if id(conn) not in self._slots:
+                continue
             if (int(st.n_frames[row]) == 0 and not bool(st.bad[row])
                     and int(st.resid[row]) == 0
                     and len(buf) >= self.stream_len):

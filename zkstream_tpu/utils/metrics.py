@@ -39,9 +39,33 @@ class Counter:
         return '\n'.join(lines)
 
 
+class Gauge:
+    """A pull-model gauge: the value is read from a callback at
+    exposition time — zero hot-path cost for instrumented components
+    (the fleet ingest binds its tick/frame counters this way)."""
+
+    def __init__(self, name: str, fn, help_text: str = ''):
+        self.name = name
+        self.help = help_text
+        self._fn = fn
+
+    def expose(self) -> str:
+        lines = []
+        if self.help:
+            lines.append('# HELP %s %s' % (self.name, self.help))
+        lines.append('# TYPE %s gauge' % (self.name,))
+        try:
+            val = self._fn()
+        except Exception:  # a dead callback must not sink exposition
+            val = float('nan')
+        lines.append('%s %s' % (self.name, val))
+        return '\n'.join(lines)
+
+
 class Collector:
     def __init__(self) -> None:
         self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
 
     def counter(self, name: str, help_text: str = '') -> Counter:
         """Create (or fetch) a counter by name — idempotent, like
@@ -50,8 +74,15 @@ class Collector:
             self._counters[name] = Counter(name, help_text)
         return self._counters[name]
 
+    def gauge(self, name: str, fn, help_text: str = '') -> Gauge:
+        """Register (or replace) a callback-backed gauge."""
+        self._gauges[name] = Gauge(name, fn, help_text)
+        return self._gauges[name]
+
     def get_collector(self, name: str) -> Counter:
         return self._counters[name]
 
     def expose(self) -> str:
-        return '\n'.join(c.expose() for c in self._counters.values())
+        parts = [c.expose() for c in self._counters.values()]
+        parts += [g.expose() for g in self._gauges.values()]
+        return '\n'.join(parts)
