@@ -2,6 +2,8 @@
 client's event counter (the rebuild's artedi equivalent,
 reference: lib/client.js:29,58-61,222-235)."""
 
+import pytest
+
 from zkstream_tpu import Client, Collector
 
 
@@ -88,3 +90,22 @@ def test_gauge_callback_failure_does_not_sink_exposition():
     text = col.expose()
     assert 'ok_gauge 7' in text
     assert 'bad_gauge nan' in text
+
+
+def test_gauge_name_collision_raises():
+    """Silently replacing a gauge would drop the first registrant's
+    series; two ingests sharing a collector use distinct prefixes."""
+    from zkstream_tpu import Collector
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    col = Collector()
+    a, b = FleetIngest(), FleetIngest()
+    a.bind_metrics(col)
+    with pytest.raises(ValueError):
+        b.bind_metrics(col)
+    b.bind_metrics(col, prefix='b_')
+    text = col.expose()
+    assert 'zkstream_ingest_ticks 0' in text
+    assert 'b_zkstream_ingest_ticks 0' in text
+    # gauges are reachable through the same lookup as counters
+    assert col.get_collector('b_zkstream_ingest_ticks') is not None

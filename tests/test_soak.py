@@ -62,7 +62,15 @@ def _ingest_variants():
             max_name=16, max_acls=2, max_scheme=8, max_id=16),
         'ingest-bypass': lambda: FleetIngest(
             body_mode='host', max_frames=8),  # default bypass
+        'ingest-mesh': _mesh_variant,  # dp-sharded tick under fire
     }
+
+
+def _mesh_variant():
+    from zkstream_tpu.parallel import MeshFleetIngest, make_mesh
+
+    return MeshFleetIngest(mesh=make_mesh(dp=8), body_mode='host',
+                           max_frames=8, min_len=1024)
 
 
 async def _prewarm(ingest: FleetIngest | None) -> None:

@@ -416,11 +416,14 @@ class FleetIngest:
                          name='ingest-warm').start()
         return ev
 
-    def bind_metrics(self, collector) -> None:
+    def bind_metrics(self, collector, prefix: str = '') -> None:
         """Expose this ingest's tick/frame counters as pull-model
         gauges on ``collector`` (utils/metrics.Collector) — the
         observability twin of the reference's artedi counters
-        (lib/client.js:29,58-61) for the batched plane."""
+        (lib/client.js:29,58-61) for the batched plane.  When several
+        ingests share one collector, give each a distinct ``prefix``
+        (name collisions raise rather than silently dropping a
+        registrant's series)."""
         for name, attr, help_text in (
                 ('zkstream_ingest_ticks', 'ticks',
                  'device ticks dispatched'),
@@ -434,7 +437,8 @@ class FleetIngest:
                  'frames delivered through the ingest'),
                 ('zkstream_ingest_body_fallbacks', 'body_fallbacks',
                  'device-body frames that needed the scalar reader')):
-            collector.gauge(name, (lambda a=attr: getattr(self, a)),
+            collector.gauge(prefix + name,
+                            (lambda a=attr: getattr(self, a)),
                             help_text)
 
     async def prewarm(self, n_streams: int,

@@ -64,10 +64,10 @@ class MeshFleetIngest(FleetIngest):
     def _resolve_placement(self) -> None:
         self._placed = True
 
-    def bind_metrics(self, collector) -> None:
-        super().bind_metrics(collector)
+    def bind_metrics(self, collector, prefix: str = '') -> None:
+        super().bind_metrics(collector, prefix)
         collector.gauge(
-            'zkstream_fleet_max_zxid',
+            prefix + 'zkstream_fleet_max_zxid',
             lambda: self.fleet_max_zxid,
             'fleet-global max zxid (pmax over the mesh) — the '
             'proxy-level session resume checkpoint')

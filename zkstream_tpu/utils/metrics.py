@@ -75,12 +75,21 @@ class Collector:
         return self._counters[name]
 
     def gauge(self, name: str, fn, help_text: str = '') -> Gauge:
-        """Register (or replace) a callback-backed gauge."""
+        """Register a callback-backed gauge.  A name collision raises:
+        silently replacing would drop the first registrant's series
+        (bind two instrumented components under distinct prefixes
+        instead)."""
+        if name in self._gauges or name in self._counters:
+            raise ValueError(
+                'metric %r already registered; use a distinct '
+                'name/prefix' % (name,))
         self._gauges[name] = Gauge(name, fn, help_text)
         return self._gauges[name]
 
-    def get_collector(self, name: str) -> Counter:
-        return self._counters[name]
+    def get_collector(self, name: str):
+        if name in self._counters:
+            return self._counters[name]
+        return self._gauges[name]
 
     def expose(self) -> str:
         parts = [c.expose() for c in self._counters.values()]
