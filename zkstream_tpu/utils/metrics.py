@@ -70,6 +70,9 @@ class Collector:
     def counter(self, name: str, help_text: str = '') -> Counter:
         """Create (or fetch) a counter by name — idempotent, like
         artedi's collector.counter()."""
+        if name in self._gauges:
+            raise ValueError(
+                'metric %r already registered as a gauge' % (name,))
         if name not in self._counters:
             self._counters[name] = Counter(name, help_text)
         return self._counters[name]
