@@ -59,6 +59,14 @@ async def run_cell(mode: str, n_conns: int) -> dict:
         ingest = FleetIngest(body_mode='host', max_frames=MAX_FRAMES,
                              bypass_bytes=0)
         kw['use_native_codec'] = False
+    elif mode == 'ingest-py-dev':
+        # the no-toolchain regime with the full tensor plane: bodies
+        # come from device planes instead of a Python re-parse
+        from zkstream_tpu.io.ingest import FleetIngest
+        ingest = FleetIngest(body_mode='device', max_frames=MAX_FRAMES,
+                             bypass_bytes=0, min_len=1024,
+                             max_data=128, max_path=64)
+        kw['use_native_codec'] = False
     elif mode == 'native':
         kw['use_native_codec'] = True
     elif mode == 'python':
@@ -83,9 +91,11 @@ async def run_cell(mode: str, n_conns: int) -> dict:
             while bp < n_conns:
                 await ingest.prewarm(bp)
                 await ingest.prewarm(bp, 512)
+                await ingest.prewarm(bp, 1024)
                 bp *= 2
             await ingest.prewarm(n_conns)
             await ingest.prewarm(n_conns, 512)
+            await ingest.prewarm(n_conns, 1024)
 
         # warm steady state
         for _ in range(3):
