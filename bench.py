@@ -471,6 +471,10 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
         if ingest is not None:
             out['ingest_ticks'] = ingest.ticks
             out['ingest_scalar_ticks'] = ingest.ticks_scalar
+            # nonzero = a bucket miss sent timed ops through the
+            # scalar drain while its program compiled; published so
+            # 'ingest'-labeled numbers are honest about it
+            out['ingest_warming_ticks'] = ingest.ticks_warming
             out['ingest_frames'] = ingest.frames_routed
     finally:
         await asyncio.gather(*[c.close() for c in clients])

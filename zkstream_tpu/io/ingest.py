@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -181,6 +182,11 @@ class FleetIngest:
         #: failed; that bucket stays on the scalar drain)
         self._exec: dict = {}
         self._warm_events: dict = {}
+        #: background compiles drain FIFO through a one-thread
+        #: executor (created lazily): a load pattern hopping several
+        #: (Bp, L) buckets at once must not stack ~1 s XLA compiles
+        #: concurrently on the host that is also serving scalar ticks
+        self._warm_pool: ThreadPoolExecutor | None = None
 
     # -- connection registry --
 
@@ -389,31 +395,36 @@ class FleetIngest:
         return ex
 
     def _start_warm(self, key: tuple) -> asyncio.Event:
-        """Kick off (or join) the background compile for ``key``;
-        returns the event set when the bucket is ready (or failed)."""
+        """Queue (or join) the background compile for ``key``;
+        returns the event set when the bucket is ready (or failed).
+        Compiles drain FIFO through a one-thread executor, so at most
+        one XLA compile runs at any moment and a failure is contained
+        to its task (never to the serialization mechanism)."""
         ev = self._warm_events.get(key)
         if ev is not None:
             return ev
         ev = asyncio.Event()
         self._warm_events[key] = ev
         loop = asyncio.get_running_loop()
+        if self._warm_pool is None:
+            self._warm_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix='ingest-warm')
 
         def work():
             ex = self._try_compile(key)
+
+            def done():
+                self._exec[key] = ex
+                ev.set()
+                # bytes may be waiting that deferred to scalar
+                self._schedule()
             try:
                 # the _exec write happens on the loop thread (done)
-                loop.call_soon_threadsafe(done, ex)
+                loop.call_soon_threadsafe(done)
             except RuntimeError:     # loop closed mid-compile
                 pass
 
-        def done(ex):
-            self._exec[key] = ex
-            ev.set()
-            # bytes may be waiting that deferred to scalar meanwhile
-            self._schedule()
-
-        threading.Thread(target=work, daemon=True,
-                         name='ingest-warm').start()
+        self._warm_pool.submit(work)
         return ev
 
     def bind_metrics(self, collector, prefix: str = '') -> None:
@@ -446,7 +457,11 @@ class FleetIngest:
         """Compile the tick program for an expected fleet shape up
         front (servers at startup, benchmarks before timing): the
         bucket for ``n_streams`` connections holding up to ``nbytes``
-        buffered bytes each tick (default: ``min_len``)."""
+        buffered bytes each tick (default: ``min_len``).  Concurrent
+        prewarms for several buckets drain through the single warm
+        worker one at a time (total ~= sum of compiles, not max) — the
+        same serialization that keeps background warms from
+        oversubscribing a host mid-service."""
         key = self._bucket(n_streams, nbytes or self.min_len)
         if self._exec.get(key, _MISSING) is not _MISSING:
             return

@@ -126,7 +126,15 @@ def _pallas_pocket(B: int, max_frames: int) -> bool:
     (8192, 64) the kernel holds 1.20-1.24x across repeated interleaved
     runs with block_rows=64.  Everywhere else the two are within the
     ±10 % run-noise band or jnp wins (worst pallas cell: 0.78x at
-    (32768, 8)), so jnp is the default."""
+    (32768, 8)), so jnp is the default.
+
+    Caveat: under ``shard_map`` (parallel/fleet.py) ``B`` here is the
+    per-shard LOCAL batch (global B / dp), while the pocket was
+    measured on single-device global shapes — so a mesh ingest enters
+    the pocket when each device's shard is itself pocket-sized, which
+    is the per-device work the measurement actually bounds (the kernel
+    runs per shard).  Perf-only either way: both paths are
+    property-tested equivalent."""
     return max_frames >= 32 and 4096 <= B <= 16384
 
 

@@ -195,7 +195,10 @@ class MultihostFleetIngest(MeshFleetIngest):
         self._free = list(range(local_rows - 1, -1, -1))
         self._timer = None
         self._stop_at: int | None = None
-        self._warned_capacity = False
+        #: monotonic time of the last capacity warning; overflow warns
+        #: at most once per interval so churn at saturation neither
+        #: floods the log nor runs silent (one latch forever would)
+        self._warned_capacity_at = float('-inf')
 
     # event-driven scheduling is disabled: the cadence launches ticks
     def _schedule(self) -> None:
@@ -208,13 +211,16 @@ class MultihostFleetIngest(MeshFleetIngest):
         # the cadence drains them through the scalar codec instead.
         if self._free:
             self._rows[id(conn)] = self._free.pop()
-        elif not self._warned_capacity:
-            self._warned_capacity = True
-            self.log.warning(
-                'MultihostFleetIngest capacity exceeded '
-                '(local_rows=%d); overflow connections are served by '
-                'the scalar drain — size the proxy for the host\'s '
-                'connection budget', self.local_rows)
+        else:
+            import time
+            now = time.monotonic()
+            if now - self._warned_capacity_at >= 30.0:
+                self._warned_capacity_at = now
+                self.log.warning(
+                    'MultihostFleetIngest capacity exceeded '
+                    '(local_rows=%d); overflow connections are served '
+                    'by the scalar drain — size the proxy for the '
+                    'host\'s connection budget', self.local_rows)
         super().register(conn)
 
     def unregister(self, conn) -> None:
