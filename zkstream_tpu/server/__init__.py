@@ -4,6 +4,8 @@ test harness, test/zkserver.js)."""
 
 from .server import ServerConnection, ZKEnsemble, ZKServer  # noqa: F401
 from .store import (  # noqa: F401
+    NodeTree,
+    ReplicaStore,
     ZKDatabase,
     ZKOpError,
     ZKServerSession,

@@ -10,9 +10,12 @@ create/resume, the full request set, one-shot server-side watches with
 correct locality, SET_WATCHES catch-up by relZxid, and session
 migration between ensemble members.
 
-``ZKEnsemble`` runs N servers over one shared ``ZKDatabase`` to simulate
-a quorum on localhost (see store.py for why that is faithful enough for
-the client-visible semantics).
+``ZKEnsemble`` runs N servers on localhost as a simulated quorum: one
+leader ``ZKDatabase`` sequences every write into a commit log, and each
+follower serves reads/watches from its own ``ReplicaStore`` replaying
+that log with injectable lag — so followers can genuinely trail the
+leader, stale reads are possible, and the ``sync`` op has observable
+meaning (see store.py).
 """
 
 from __future__ import annotations
@@ -23,7 +26,7 @@ import logging
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
 from ..protocol.framing import PacketCodec
-from .store import ZKDatabase, ZKOpError, ZKServerSession
+from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 
 log = logging.getLogger('zkstream_tpu.server')
 
@@ -35,7 +38,8 @@ class ServerConnection:
     def __init__(self, server: 'ZKServer', reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter):
         self.server = server
-        self.db = server.db
+        self.db = server.db          # the leader: writes + sessions
+        self.store = server.store    # this member's view: reads + watches
         self.reader = reader
         self.writer = writer
         self.codec = PacketCodec(server=True)
@@ -68,19 +72,22 @@ class ServerConnection:
             return
         if self.server.drop_pings and opcode == 'PING':
             return
-        pkt = {'xid': xid, 'zxid': self.db.zxid, 'err': err,
+        # the header zxid is this MEMBER's last applied transaction —
+        # a lagging follower honestly reports its own position
+        pkt = {'xid': xid, 'zxid': self.store.zxid, 'err': err,
                'opcode': opcode}
         pkt.update(body)
         self._send(pkt)
 
-    def notify(self, ntype: str, path: str) -> None:
-        """Send a watch notification; a fan-out (one db change, many
-        subscribed connections) encodes the identical packet ONCE and
-        shares the bytes — keyed by (type, path, zxid), which is unique
-        per change since zxid strictly increases per mutation."""
+    def notify(self, ntype: str, path: str, zxid: int) -> None:
+        """Send a watch notification for the change ``zxid``; a fan-out
+        (one change, many subscribed connections) encodes the identical
+        packet ONCE and shares the bytes — keyed by (type, path, zxid),
+        which is unique per change since zxid strictly increases per
+        mutation."""
         if self.closed:
             return
-        key = (ntype, path, self.db.zxid)
+        key = (ntype, path, zxid)
         cache = self.server._notif_cache
         if cache is not None and cache[0] == key:
             data = cache[1]
@@ -90,7 +97,7 @@ class ServerConnection:
             # with every subscribed connection, so they must not depend
             # on any per-connection encode state.
             data = self.server._notif_codec.encode(
-                {'xid': XID_NOTIFICATION, 'zxid': self.db.zxid,
+                {'xid': XID_NOTIFICATION, 'zxid': zxid,
                  'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
                  'state': 'SYNC_CONNECTED', 'path': path})
             self.server._notif_cache = (key, data)
@@ -102,40 +109,43 @@ class ServerConnection:
         if self._subscribed:
             return
         self._subscribed = True
-        self.db.on('created', self._on_created)
-        self.db.on('deleted', self._on_deleted)
-        self.db.on('dataChanged', self._on_data_changed)
-        self.db.on('childrenChanged', self._on_children_changed)
+        # node-change events come from THIS member's store (a watch on
+        # a lagging follower fires when the follower applies the
+        # transaction); session expiry is leader-global state
+        self.store.on('created', self._on_created)
+        self.store.on('deleted', self._on_deleted)
+        self.store.on('dataChanged', self._on_data_changed)
+        self.store.on('childrenChanged', self._on_children_changed)
         self.db.on('sessionExpired', self._on_session_expired)
 
     def _unsubscribe(self) -> None:
         if not self._subscribed:
             return
         self._subscribed = False
-        self.db.remove_listener('created', self._on_created)
-        self.db.remove_listener('deleted', self._on_deleted)
-        self.db.remove_listener('dataChanged', self._on_data_changed)
-        self.db.remove_listener('childrenChanged',
-                                self._on_children_changed)
+        self.store.remove_listener('created', self._on_created)
+        self.store.remove_listener('deleted', self._on_deleted)
+        self.store.remove_listener('dataChanged', self._on_data_changed)
+        self.store.remove_listener('childrenChanged',
+                                   self._on_children_changed)
         self.db.remove_listener('sessionExpired', self._on_session_expired)
 
     def _on_created(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
-            self.notify('CREATED', path)
+            self.notify('CREATED', path, zxid)
 
     def _on_deleted(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
-            self.notify('DELETED', path)
+            self.notify('DELETED', path, zxid)
         if self.child_watches.pop(path, None):
-            self.notify('DELETED', path)
+            self.notify('DELETED', path, zxid)
 
     def _on_data_changed(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
-            self.notify('DATA_CHANGED', path)
+            self.notify('DATA_CHANGED', path, zxid)
 
     def _on_children_changed(self, path: str, zxid: int) -> None:
         if self.child_watches.pop(path, None):
-            self.notify('CHILDREN_CHANGED', path)
+            self.notify('CHILDREN_CHANGED', path, zxid)
 
     def _on_session_expired(self, session_id: int) -> None:
         if self.session is not None and self.session.id == session_id:
@@ -231,15 +241,20 @@ class ServerConnection:
     def _op_create(self, pkt: dict) -> None:
         path = self.db.create(pkt['path'], pkt['data'], pkt['acl'],
                               CreateFlag(pkt['flags']), self.session)
+        # a write through this member catches its store up through the
+        # transaction (real ZK: the follower commits before replying),
+        # so the author can always read their own write here
+        self.store.catch_up()
         self._reply(pkt['xid'], 'CREATE', path=path)
 
     def _op_delete(self, pkt: dict) -> None:
         self.db.delete(pkt['path'], pkt['version'])
+        self.store.catch_up()
         self._reply(pkt['xid'], 'DELETE')
 
     def _op_get_data(self, pkt: dict) -> None:
         try:
-            data, stat = self.db.get_data(pkt['path'])
+            data, stat = self.store.get_data(pkt['path'])
         except ZKOpError:
             raise
         if pkt.get('watch'):
@@ -248,11 +263,12 @@ class ServerConnection:
 
     def _op_set_data(self, pkt: dict) -> None:
         stat = self.db.set_data(pkt['path'], pkt['data'], pkt['version'])
+        self.store.catch_up()
         self._reply(pkt['xid'], 'SET_DATA', stat=stat)
 
     def _op_exists(self, pkt: dict) -> None:
         try:
-            stat = self.db.exists(pkt['path'])
+            stat = self.store.exists(pkt['path'])
         except ZKOpError:
             # EXISTS with watch on a missing node arms an existence
             # watch that fires CREATED later.
@@ -264,24 +280,29 @@ class ServerConnection:
         self._reply(pkt['xid'], 'EXISTS', stat=stat)
 
     def _op_get_children(self, pkt: dict) -> None:
-        children, stat = self.db.get_children(pkt['path'])
+        children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
             self.child_watches[pkt['path']] = True
         self._reply(pkt['xid'], 'GET_CHILDREN', children=children)
 
     def _op_get_children2(self, pkt: dict) -> None:
-        children, stat = self.db.get_children(pkt['path'])
+        children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
             self.child_watches[pkt['path']] = True
         self._reply(pkt['xid'], 'GET_CHILDREN2', children=children,
                     stat=stat)
 
     def _op_get_acl(self, pkt: dict) -> None:
-        acl, stat = self.db.get_acl(pkt['path'])
+        acl, stat = self.store.get_acl(pkt['path'])
         self._reply(pkt['xid'], 'GET_ACL', acl=acl, stat=stat)
 
     def _op_sync(self, pkt: dict) -> None:
-        # Single shared database: every server is trivially caught up.
+        # Flush replication: this member applies everything the leader
+        # has committed before replying, so a read issued after the
+        # sync reply cannot see state older than the sync point —
+        # the guarantee the reference test relies on
+        # (multi-node.test.js:107-165).
+        self.store.catch_up()
         self._reply(pkt['xid'], 'SYNC')
 
     def _op_close_session(self, pkt: dict) -> None:
@@ -294,44 +315,54 @@ class ServerConnection:
         notifications for anything that moved past relZxid."""
         rel = pkt['relZxid']
         events = pkt['events']
+        # catch-up decisions run against THIS member's view: a node
+        # change the member has not applied yet fires later through the
+        # re-armed watch table when the replica applies it
+        z = self.store.zxid
         for path in events.get('dataChanged', ()):
-            node = self.db.nodes.get(path)
+            node = self.store.nodes.get(path)
             if node is None:
-                self.notify('DELETED', path)
+                self.notify('DELETED', path, z)
             else:
                 self.data_watches[path] = True
                 if node.mzxid > rel:
                     self.data_watches.pop(path, None)
-                    self.notify('DATA_CHANGED', path)
+                    self.notify('DATA_CHANGED', path, node.mzxid)
         for path in events.get('createdOrDestroyed', ()):
-            node = self.db.nodes.get(path)
+            node = self.store.nodes.get(path)
             if node is None:
                 # Missing node: the watcher may have seen it alive, so
                 # send DELETED (real ZK does the same for exist watches
                 # — it cannot know the node never existed either).
-                self.notify('DELETED', path)
+                self.notify('DELETED', path, z)
             elif node.czxid > rel:
-                self.notify('CREATED', path)
+                self.notify('CREATED', path, node.czxid)
             else:
                 self.data_watches[path] = True
         for path in events.get('childrenChanged', ()):
-            node = self.db.nodes.get(path)
+            node = self.store.nodes.get(path)
             if node is None:
-                self.notify('DELETED', path)
+                self.notify('DELETED', path, z)
             else:
                 self.child_watches[path] = True
                 if node.pzxid > rel:
                     self.child_watches.pop(path, None)
-                    self.notify('CHILDREN_CHANGED', path)
+                    self.notify('CHILDREN_CHANGED', path, node.pzxid)
         self._reply(pkt['xid'], 'SET_WATCHES')
 
 
 class ZKServer:
-    """One listening endpoint over a ZKDatabase."""
+    """One listening endpoint — a quorum member.  Writes and sessions
+    go to the leader ``db``; reads and watches are served from this
+    member's ``store`` (the leader's own tree for a standalone server
+    or the ensemble leader, a :class:`~.store.ReplicaStore` for a
+    follower)."""
 
     def __init__(self, db: ZKDatabase | None = None,
-                 host: str = '127.0.0.1', port: int = 0):
+                 host: str = '127.0.0.1', port: int = 0,
+                 store=None):
         self.db = db if db is not None else ZKDatabase()
+        self.store = store if store is not None else self.db
         self.host = host
         self.port = port
         self._server: asyncio.base_events.Server | None = None
@@ -383,13 +414,31 @@ class ZKServer:
 
 
 class ZKEnsemble:
-    """N servers over one shared database: localhost stand-in for a ZK
-    quorum (reference analogue: test/multi-node.test.js's three real
-    servers on distinct ports)."""
+    """N quorum members on localhost (reference analogue:
+    test/multi-node.test.js's three real servers on distinct ports).
+    Member 0 is the leader; members 1.. are followers, each with its
+    own :class:`~.store.ReplicaStore` replaying the leader's commit
+    log.  With the default ``lag=0`` replication is synchronous (a
+    perfect network); ``set_lag`` makes a follower genuinely trail the
+    leader — stale reads included — which is what gives ``sync`` its
+    meaning (tests/test_multi_node.py drives both regimes)."""
 
-    def __init__(self, count: int = 3, host: str = '127.0.0.1'):
+    def __init__(self, count: int = 3, host: str = '127.0.0.1',
+                 lag: float | None = 0.0):
         self.db = ZKDatabase()
-        self.servers = [ZKServer(self.db, host=host) for _ in range(count)]
+        self.servers = [
+            ZKServer(self.db, host=host,
+                     store=None if i == 0 else ReplicaStore(self.db,
+                                                            lag=lag))
+            for i in range(count)]
+
+    def set_lag(self, idx: int, lag: float | None) -> None:
+        """Change follower ``idx``'s replication lag (0 = synchronous,
+        seconds = timed delay, None = hold until sync/write)."""
+        store = self.servers[idx].store
+        if not isinstance(store, ReplicaStore):
+            raise ValueError('member %d is the leader' % (idx,))
+        store.lag = lag
 
     async def start(self) -> 'ZKEnsemble':
         for s in self.servers:
@@ -404,9 +453,11 @@ class ZKEnsemble:
         await self.servers[idx].stop()
 
     async def restart(self, idx: int) -> None:
-        """Bring a killed member back on its old port."""
+        """Bring a killed member back on its old port; a rejoining
+        follower first syncs with the leader, like a real one."""
         srv = self.servers[idx]
         assert srv._server is None, 'server still running'
+        srv.store.catch_up()
         srv._server = await asyncio.start_server(
             srv._on_client, srv.host, srv.port)
 

@@ -7,12 +7,23 @@ tree with full Stat bookkeeping, zxid allocation, session lifecycle with
 expiry timers and ephemeral cleanup, sequential-node numbering, and
 change events that per-connection watch tables subscribe to.
 
-One ``ZKDatabase`` can back several listening servers at once, which is
-how the 3-node-ensemble failover tests run without a real quorum: the
-servers share committed state (as a ZAB quorum would) while sessions and
-watches keep their real locality semantics — a watch lives on the
-connection that set it; a session survives its server dying as long as
-the client resumes it anywhere within the timeout.
+Replication model (the quorum analogue): one ``ZKDatabase`` is the
+**leader** — it validates and sequences every write, allocates zxids,
+and appends each committed transaction to an in-order commit log.  Each
+ensemble follower serves reads from its own ``ReplicaStore``, a separate
+znode tree fed by that log with injectable lag — so a follower can be
+*behind* the leader and serve a genuinely stale read, which is what
+gives the client's ``sync`` op observable meaning (reference semantics:
+test/multi-node.test.js:107-165 — a follower may lag until sync).
+Sessions stay leader-global (in real ZK they are quorum state tracked
+by the leader), so a session survives its serving member dying as long
+as the client resumes it anywhere within the timeout, and ephemeral
+cleanup is itself a sequence of logged deletes that replicate like any
+other write.
+
+Both leader and replicas mutate their trees through the shared
+``NodeTree._apply_*`` primitives, so a replayed transaction produces a
+byte-identical Stat on every member.
 """
 
 from __future__ import annotations
@@ -55,7 +66,8 @@ class Znode:
     children: set = dataclasses.field(default_factory=set)
     #: Monotonic sequential-suffix counter (real ZK derives this from
     #: cversion; an explicit counter keeps numbering stable across
-    #: deletes).
+    #: deletes).  Leader-only: sequential names are resolved before a
+    #: create is logged, so replicas never consult it.
     seq: int = 0
 
     def stat(self) -> Stat:
@@ -94,19 +106,109 @@ def validate_path(path: str) -> None:
         raise ZKOpError('BAD_ARGUMENTS')
 
 
-class ZKDatabase(EventEmitter):
-    """Committed state shared by every server of a (simulated) ensemble.
+class NodeTree(EventEmitter):
+    """A znode tree plus the deterministic transaction-apply primitives
+    shared by the leader and every replica — one code path mutates all
+    members' trees, so replayed state cannot drift.
 
-    Change events (for watch tables): ``created(path, zxid)``,
-    ``deleted(path, zxid)``, ``dataChanged(path, zxid)``,
-    ``childrenChanged(path, zxid)``, ``sessionExpired(session_id)``.
+    Change events (for per-connection watch tables):
+    ``created(path, zxid)``, ``deleted(path, zxid)``,
+    ``dataChanged(path, zxid)``, ``childrenChanged(path, zxid)``.
+    ``zxid`` is the last transaction applied to THIS tree (== the
+    leader's on a caught-up member, behind it on a lagging one).
     """
 
     def __init__(self) -> None:
         super().__init__()
         self.nodes: dict[str, Znode] = {'/': Znode()}
         self.zxid = 0
+
+    # -- transaction apply (leader commit path + replica replay) --
+
+    def _apply_create(self, path: str, data: bytes, acl: tuple,
+                      ephemeral_owner: int, zxid: int, now: int) -> None:
+        node = Znode(data=data, acl=acl, czxid=zxid, mzxid=zxid,
+                     pzxid=zxid, ctime=now, mtime=now,
+                     ephemeral_owner=ephemeral_owner)
+        self.nodes[path] = node
+        ppath = parent_path(path)
+        parent = self.nodes[ppath]
+        parent.children.add(path.rsplit('/', 1)[1])
+        parent.cversion += 1
+        parent.pzxid = zxid
+        self.zxid = zxid
+        self.emit('created', path, zxid)
+        self.emit('childrenChanged', ppath, zxid)
+
+    def _apply_delete(self, path: str, zxid: int) -> Znode:
+        node = self.nodes.pop(path)
+        ppath = parent_path(path)
+        parent = self.nodes.get(ppath)
+        if parent is not None:
+            parent.children.discard(path.rsplit('/', 1)[1])
+            parent.cversion += 1
+            parent.pzxid = zxid
+        self.zxid = zxid
+        self.emit('deleted', path, zxid)
+        self.emit('childrenChanged', ppath, zxid)
+        return node
+
+    def _apply_set_data(self, path: str, data: bytes, zxid: int,
+                        now: int) -> Znode:
+        node = self.nodes[path]
+        node.data = data
+        node.version += 1
+        node.mzxid = zxid
+        node.mtime = now
+        self.zxid = zxid
+        self.emit('dataChanged', path, zxid)
+        return node
+
+    # -- reads (serve from this member's view) --
+
+    def get_data(self, path: str) -> tuple[bytes, Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return node.data, node.stat()
+
+    def exists(self, path: str) -> Stat:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return node.stat()
+
+    def get_children(self, path: str) -> tuple[list[str], Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return sorted(node.children), node.stat()
+
+    def get_acl(self, path: str) -> tuple[list[ACL], Stat]:
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        return list(node.acl), node.stat()
+
+
+class ZKDatabase(NodeTree):
+    """The leader: validates and sequences writes, allocates zxids,
+    owns the session table, and appends every committed transaction to
+    ``log`` (emitting ``committed`` for replicas to consume).
+
+    Extra events beyond :class:`NodeTree`'s:
+    ``sessionExpired(session_id)``, ``committed()``.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
         self.sessions: dict[int, ZKServerSession] = {}
+        #: The commit log: every mutation, in zxid order, as a
+        #: self-contained entry a :class:`ReplicaStore` can replay.
+        #: Only kept once a replica attaches — a standalone server
+        #: must not retain every payload for the process lifetime.
+        self.log: list[tuple] = []
+        self._replicated = False
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
         self._next_session = ((int(time.time() * 1000) << 24)
                               & 0x7fffffffffff0000)
@@ -120,6 +222,25 @@ class ZKDatabase(EventEmitter):
     @staticmethod
     def now_ms() -> int:
         return int(time.time() * 1000)
+
+    def catch_up(self) -> None:
+        """The leader is always caught up (uniform member interface)."""
+
+    def attach_replica(self) -> None:
+        """Called by :class:`ReplicaStore` — from here on, committed
+        transactions are retained in ``log`` for replay.  Must happen
+        before the first transaction: a replica cannot replay history
+        that was never kept."""
+        if self.zxid != 0:
+            raise ValueError(
+                'replica attached after %d transactions; the commit '
+                'log only starts recording at attach' % (self.zxid,))
+        self._replicated = True
+
+    def _commit(self, entry: tuple) -> None:
+        if self._replicated:
+            self.log.append(entry)
+            self.emit('committed')
 
     # -- session lifecycle --
 
@@ -185,7 +306,7 @@ class ZKDatabase(EventEmitter):
                     log.warning('could not reap ephemeral %s', path)
         sess.ephemerals.clear()
 
-    # -- znode operations --
+    # -- znode writes (validate, sequence, apply, commit) --
 
     def create(self, path: str, data: bytes, acl, flags: CreateFlag,
                session: ZKServerSession | None = None) -> str:
@@ -204,23 +325,17 @@ class ZKDatabase(EventEmitter):
         if path in self.nodes:
             raise ZKOpError('NODE_EXISTS')
 
-        zxid = self.next_zxid()
-        now = self.now_ms()
-        node = Znode(data=data, acl=tuple(acl) if acl else OPEN_ACL_UNSAFE,
-                     czxid=zxid, mzxid=zxid, pzxid=zxid,
-                     ctime=now, mtime=now)
+        eph_owner = 0
         if flags & CreateFlag.EPHEMERAL:
             if session is None:
                 raise ZKOpError('BAD_ARGUMENTS')
-            node.ephemeral_owner = session.id
+            eph_owner = session.id
             session.ephemerals.add(path)
-        self.nodes[path] = node
-        parent.children.add(path.rsplit('/', 1)[1])
-        parent.cversion += 1
-        parent.pzxid = zxid
-
-        self.emit('created', path, zxid)
-        self.emit('childrenChanged', parent_path(path), zxid)
+        acl_t = tuple(acl) if acl else OPEN_ACL_UNSAFE
+        zxid = self.next_zxid()
+        now = self.now_ms()
+        self._apply_create(path, data, acl_t, eph_owner, zxid, now)
+        self._commit(('create', path, data, acl_t, eph_owner, zxid, now))
         return path
 
     def delete(self, path: str, version: int) -> None:
@@ -234,20 +349,12 @@ class ZKDatabase(EventEmitter):
             raise ZKOpError('BAD_VERSION')
 
         zxid = self.next_zxid()
-        del self.nodes[path]
-        ppath = parent_path(path)
-        parent = self.nodes.get(ppath)
-        if parent is not None:
-            parent.children.discard(path.rsplit('/', 1)[1])
-            parent.cversion += 1
-            parent.pzxid = zxid
+        node = self._apply_delete(path, zxid)
         if node.ephemeral_owner:
             sess = self.sessions.get(node.ephemeral_owner)
             if sess is not None:
                 sess.ephemerals.discard(path)
-
-        self.emit('deleted', path, zxid)
-        self.emit('childrenChanged', ppath, zxid)
+        self._commit(('delete', path, zxid))
 
     def set_data(self, path: str, data: bytes, version: int) -> Stat:
         validate_path(path)
@@ -257,33 +364,73 @@ class ZKDatabase(EventEmitter):
         if version >= 0 and version != node.version:
             raise ZKOpError('BAD_VERSION')
         zxid = self.next_zxid()
-        node.data = data
-        node.version += 1
-        node.mzxid = zxid
-        node.mtime = self.now_ms()
-        self.emit('dataChanged', path, zxid)
+        node = self._apply_set_data(path, data, zxid, self.now_ms())
+        self._commit(('set_data', path, node.data, zxid, node.mtime))
         return node.stat()
 
-    def get_data(self, path: str) -> tuple[bytes, Stat]:
-        node = self.nodes.get(path)
-        if node is None:
-            raise ZKOpError('NO_NODE')
-        return node.data, node.stat()
 
-    def exists(self, path: str) -> Stat:
-        node = self.nodes.get(path)
-        if node is None:
-            raise ZKOpError('NO_NODE')
-        return node.stat()
+class ReplicaStore(NodeTree):
+    """One follower's local view of the tree, fed by the leader's
+    commit log.
 
-    def get_children(self, path: str) -> tuple[list[str], Stat]:
-        node = self.nodes.get(path)
-        if node is None:
-            raise ZKOpError('NO_NODE')
-        return sorted(node.children), node.stat()
+    ``lag`` controls replication delay:
 
-    def get_acl(self, path: str) -> tuple[list[ACL], Stat]:
-        node = self.nodes.get(path)
-        if node is None:
-            raise ZKOpError('NO_NODE')
-        return list(node.acl), node.stat()
+    - ``0`` (default): apply synchronously at commit — a perfect
+      network; every existing single-tick visibility expectation holds;
+    - ``> 0``: apply each transaction ``lag`` seconds after commit —
+      a follower that genuinely trails the leader;
+    - ``None``: apply only on :meth:`catch_up` (the ``sync`` op or a
+      write through this member) — a deterministically stale follower
+      for tests.
+
+    Watch locality falls out naturally: a server connection's watch
+    tables subscribe to its member's store, so a watch on a lagging
+    follower fires when THAT member applies the transaction, exactly
+    like a real follower committing behind the leader.
+    """
+
+    def __init__(self, leader: ZKDatabase, lag: float | None = 0.0):
+        super().__init__()
+        self.leader = leader
+        self.lag = lag
+        #: index into ``leader.log`` of the next entry to apply
+        self.applied = 0
+        leader.attach_replica()
+        leader.on('committed', self._on_commit)
+
+    def _on_commit(self) -> None:
+        if self.lag is None:
+            return
+        if self.lag <= 0:
+            self._apply_until(len(self.leader.log))
+        else:
+            ambient_loop().call_later(
+                self.lag, self._apply_until, len(self.leader.log))
+
+    def _apply_until(self, target: int) -> None:
+        """Apply log entries up to index ``target`` (idempotent: a
+        timer firing after a ``catch_up`` already passed it is a
+        no-op, so application order is always log order)."""
+        log_ = self.leader.log
+        while self.applied < min(target, len(log_)):
+            self._apply_one(log_[self.applied])
+            self.applied += 1
+
+    def _apply_one(self, entry: tuple) -> None:
+        op = entry[0]
+        if op == 'create':
+            _, path, data, acl, eph_owner, zxid, now = entry
+            self._apply_create(path, data, acl, eph_owner, zxid, now)
+        elif op == 'delete':
+            self._apply_delete(entry[1], entry[2])
+        elif op == 'set_data':
+            _, path, data, zxid, now = entry
+            self._apply_set_data(path, data, zxid, now)
+        else:  # pragma: no cover - log entries are produced above
+            raise AssertionError('unknown log entry %r' % (op,))
+
+    def catch_up(self) -> None:
+        """Apply everything committed so far — the ``sync`` op's
+        flush, and what a write through this member does so its
+        author can read their own write."""
+        self._apply_until(len(self.leader.log))
