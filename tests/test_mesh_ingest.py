@@ -198,3 +198,95 @@ async def test_multihost_fleet_ingest_single_process():
         await proxy.stop(after_ticks=stop_at)
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()
+
+
+@pytest.mark.timeout(75)
+async def test_multihost_assembly_failure_keeps_launches_aligned():
+    """A host-side error BEFORE the dispatch must not skip a
+    collective launch (it would strand the other hosts' matching
+    launches): the tick falls back to an empty aligned launch, the
+    buffered bytes survive, and the next healthy tick delivers them —
+    ops are delayed one interval, never lost (VERDICT r3 weak #6)."""
+    from zkstream_tpu.parallel import MultihostFleetIngest
+
+    proxy = MultihostFleetIngest(mesh=make_mesh(dp=8), local_rows=8,
+                                 stream_len=2048, tick_interval=0.005,
+                                 body_mode='host', max_frames=4)
+    srv = await ZKServer().start()
+    proxy.warmup_tick()
+    clients = [make_client(srv.port, proxy) for _ in range(4)]
+    try:
+        proxy.start()
+        await asyncio.gather(*[c.wait_connected(timeout=10)
+                               for c in clients])
+        await clients[0].create('/af', b'v')
+
+        # inject: the next 3 ticks fail host-side assembly
+        fail = {'n': 3}
+        orig = proxy._assemble_tick
+
+        def boom():
+            if fail['n'] > 0:
+                fail['n'] -= 1
+                raise RuntimeError('injected assembly failure')
+            return orig()
+        proxy._assemble_tick = boom
+
+        # ops issued during the failure window still complete: replies
+        # buffer through the empty-launch ticks and deliver on the
+        # first healthy one
+        datas = await asyncio.gather(*[c.get('/af') for c in clients])
+        assert [d for d, _s in datas] == [b'v'] * 4
+        assert fail['n'] == 0, 'injection never exercised'
+        # every counted tick launched its collective
+        assert proxy.launch_count == proxy.tick_count
+    finally:
+        await proxy.stop(after_ticks=proxy.tick_count + 1)
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()
+
+
+@pytest.mark.timeout(75)
+async def test_multihost_dispatch_failure_detected_loudly():
+    """A failed DISPATCH genuinely breaks the cross-host launch
+    alignment; the cadence survives (other ticks keep launching) and
+    ``stop`` reports the divergence with a RuntimeError instead of
+    letting the other hosts hang silently (VERDICT r3 weak #6)."""
+    from zkstream_tpu.parallel import MultihostFleetIngest
+
+    proxy = MultihostFleetIngest(mesh=make_mesh(dp=8), local_rows=8,
+                                 stream_len=2048, tick_interval=0.005,
+                                 body_mode='host', max_frames=4)
+    srv = await ZKServer().start()
+    proxy.warmup_tick()
+    clients = [make_client(srv.port, proxy) for _ in range(2)]
+    try:
+        proxy.start()
+        await asyncio.gather(*[c.wait_connected(timeout=10)
+                               for c in clients])
+        await clients[0].create('/df', b'v')
+
+        # break exactly one dispatch: the compiled fn raises once
+        real_fn = proxy._fns[False]
+        fail = {'n': 1}
+
+        def bad_fn(*a, **k):
+            if fail['n'] > 0:
+                fail['n'] -= 1
+                raise RuntimeError('injected dispatch failure')
+            return real_fn(*a, **k)
+        proxy._fns[False] = bad_fn
+
+        # traffic forces ticks through the broken dispatch
+        data, _ = await clients[1].get('/df')
+        assert data == b'v'         # later ticks still serve
+        assert fail['n'] == 0
+        assert proxy.launch_count < proxy.tick_count
+        with pytest.raises(RuntimeError, match='launch divergence'):
+            await proxy.stop(after_ticks=proxy.tick_count + 1)
+    finally:
+        if proxy._timer is not None:    # stop raised after joining
+            proxy._timer.cancel()
+            proxy._timer = None
+        await asyncio.gather(*[c.close() for c in clients])
+        await srv.stop()

@@ -191,6 +191,11 @@ class MultihostFleetIngest(MeshFleetIngest):
         self.stream_len = stream_len
         self.tick_interval = tick_interval
         self.tick_count = 0
+        #: collective launches actually dispatched; == tick_count
+        #: unless a dispatch itself failed (host-side assembly failures
+        #: fall back to an empty aligned launch and so keep the two
+        #: equal).  ``stop`` checks the invariant loudly.
+        self.launch_count = 0
         self._rows: dict[int, int] = {}       # id(conn) -> row
         self._free = list(range(local_rows - 1, -1, -1))
         self._timer = None
@@ -277,6 +282,16 @@ class MultihostFleetIngest(MeshFleetIngest):
             except asyncio.CancelledError:
                 pass
         self._timer = None
+        if self.launch_count != self.tick_count:
+            # a dispatch failed somewhere along the run: this host
+            # launched fewer collectives than its cadence counted, so
+            # the other hosts' matching collectives are stranded —
+            # surface it here rather than letting them hang silently
+            raise RuntimeError(
+                'collective launch divergence: %d launches for %d '
+                'ticks — a dispatch failed mid-cadence; the other '
+                'hosts\' launch counts no longer match this one'
+                % (self.launch_count, self.tick_count))
 
     async def _cadence(self) -> None:
         import asyncio
@@ -295,9 +310,13 @@ class MultihostFleetIngest(MeshFleetIngest):
                 # keep launching: a dead cadence on one host strands
                 # every other host's collectives (their readbacks
                 # block), turning one local error into a fleet-wide
-                # stall.  (An exception BEFORE the dispatch still
-                # skips a launch — unavoidable — but the common
-                # failures are host-side, after it.)
+                # stall.  Pre-dispatch host-side errors fall back to
+                # an empty aligned launch inside _mh_tick; what
+                # reaches here is a failed dispatch (or an empty
+                # launch that itself failed) or a routing/delivery
+                # error after the dispatch — either way the cadence
+                # continues and ``stop``'s launch/tick invariant says
+                # whether alignment held.
                 self.log.exception('multihost tick failed; '
                                    'cadence continues')
 
@@ -309,10 +328,10 @@ class MultihostFleetIngest(MeshFleetIngest):
         return np.concatenate([np.asarray(s.data) for s in shards],
                               axis=0)
 
-    def _mh_tick(self) -> None:
-        from .multihost import host_local_wire_batch
-
-        self.tick_count += 1
+    def _assemble_tick(self):
+        """Host-side tick assembly: copy each rowed connection's
+        buffered bytes into the fixed-shape local batch.  Returns
+        (batch, lens, active, overflow)."""
         batch = np.zeros((self.local_rows, self.stream_len), np.uint8)
         lens = np.zeros((self.local_rows,), np.int32)
         active = {}
@@ -329,19 +348,47 @@ class MultihostFleetIngest(MeshFleetIngest):
                                            np.uint8)
             lens[row] = n
             active[row] = (conn, buf)
+        return batch, lens, active, overflow
 
+    def _mh_tick(self) -> None:
+        from .multihost import host_local_wire_batch
+
+        self.tick_count += 1
         device = self.body_mode == 'device'
-        fn = self._step_fn(device)
-        gbuf, glens = host_local_wire_batch(self.mesh, batch, lens)
+        try:
+            batch, lens, active, overflow = self._assemble_tick()
+            fn = self._step_fn(device)
+            gbuf, glens = host_local_wire_batch(self.mesh, batch, lens)
+        except Exception:
+            # A pre-dispatch host-side failure (assembly, tracing, or
+            # the device placement of the local shards) must not skip
+            # the collective launch — the other hosts' matching
+            # launches would strand.  Retry the whole pre-dispatch
+            # path with an EMPTY batch: nothing was consumed, so the
+            # buffered bytes are intact and the next healthy tick
+            # delivers them one interval late.  If even the empty
+            # placement fails, the launch is genuinely impossible —
+            # the error propagates and ``stop``'s launch/tick check
+            # reports the divergence.
+            self.log.exception('multihost tick pre-dispatch failed; '
+                               'launching an empty aligned tick')
+            batch = np.zeros((self.local_rows, self.stream_len),
+                             np.uint8)
+            lens = np.zeros((self.local_rows,), np.int32)
+            active, overflow = {}, []
+            fn = self._step_fn(device)
+            gbuf, glens = host_local_wire_batch(self.mesh, batch, lens)
         # the launch itself is unconditional — collective alignment.
         # Global stats read back on every tick (they carry the OTHER
         # hosts' traffic too); the body planes only when this host has
         # frames to route.
         if device:
             ints, byts = fn(gbuf, glens)
+            self.launch_count += 1
             byts = self._local_view(byts) if active else None
         else:
             ints = fn(gbuf, glens)
+            self.launch_count += 1
             byts = None
         ints = self._local_view(ints)
         st, bd = self._unpack(ints, byts)

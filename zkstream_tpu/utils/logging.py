@@ -81,6 +81,14 @@ class Logger:
     def error(self, msg: str, *args) -> None:
         self._log(_logging.ERROR, msg, *args)
 
+    def exception(self, msg: str, *args) -> None:
+        """Error-level log with the ACTIVE exception's traceback
+        appended — for except-blocks that swallow an error to keep a
+        loop alive (e.g. the multihost cadence) but must not hide it."""
+        import traceback
+        self._log(_logging.ERROR,
+                  msg + '\n%s', *(args + (traceback.format_exc(),)))
+
     def fatal(self, msg: str, *args) -> None:
         """Bunyan's top level (the reference logs at fatal before
         crash-on-bug throws)."""
