@@ -453,3 +453,35 @@ def test_randomized_fleet_equivalence():
         cut = rng.randrange(len(wire))
         got = c.decode(wire[:cut]) + c.decode(wire[cut:])
         assert got == b
+
+
+def test_differential_fuzz_request_decode():
+    """Differential fuzz of the server-direction request decode
+    (VERDICT r3 Next #7): the C extension is a genuinely independent
+    second implementation of the same wire grammar, so running both
+    over random, half-structured, and corrupted-suffix frames and
+    demanding identical packets, identical pre-error packet retention,
+    and identical error codes certifies the request grammar with
+    inputs no encoder in this repo produced."""
+    rng = random.Random(0xC0FFEE)
+    op_nums = [1, 2, 3, 4, 5, 6, 8, 9, 11, 12, -11, 101,
+               100, 7, 13, 9999, 0, -1]   # valid + unsupported + junk
+    for trial in range(600):
+        kind = rng.random()
+        if kind < 0.35:            # pure noise body
+            body = rng.randbytes(rng.randrange(0, 48))
+        elif kind < 0.8:           # plausible header + noise tail
+            body = struct.pack('>ii', rng.randrange(-16, 1 << 12),
+                               rng.choice(op_nums))
+            body += rng.randbytes(rng.randrange(0, 40))
+        else:                      # valid request, corrupted suffix
+            base = encode_requests([rng.choice(ALL_REQUESTS)])[4:]
+            cut = rng.randrange(0, len(base) + 1)
+            body = base[:cut] + rng.randbytes(rng.randrange(0, 12))
+        wire = b''
+        if rng.random() < 0.4:     # a good frame ahead of the fuzzed
+            wire += encode_requests([rng.choice(ALL_REQUESTS)])
+        wire += struct.pack('>i', len(body)) + body
+        py, (k1, p1, c1), ext, (k2, p2, c2) = server_decode_both(wire)
+        assert (k1, c1) == (k2, c2), (trial, wire.hex(), c1, c2)
+        assert p1 == p2, (trial, wire.hex(), p1, p2)

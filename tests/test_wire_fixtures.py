@@ -119,6 +119,63 @@ REQUEST_FIXTURES = [
                     'createdOrDestroyed': [],
                     'childrenChanged': ['/c1', '/c2']}},
     ),
+    (
+        'GET_DATA',
+        b'\x00\x00\x00\x09'               # xid = 9
+        b'\x00\x00\x00\x04'               # opcode GET_DATA = 4
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00',                          # watch = false
+        {'xid': 9, 'opcode': 'GET_DATA', 'path': '/a', 'watch': False},
+    ),
+    (
+        'GET_CHILDREN',
+        b'\x00\x00\x00\x0a'               # xid = 10
+        b'\x00\x00\x00\x08'               # opcode GET_CHILDREN = 8
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x01',                          # watch = true
+        {'xid': 10, 'opcode': 'GET_CHILDREN', 'path': '/a',
+         'watch': True},
+    ),
+    (
+        'GET_CHILDREN2',
+        b'\x00\x00\x00\x0b'               # xid = 11
+        b'\x00\x00\x00\x0c'               # opcode GET_CHILDREN2 = 12
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00',                          # watch = false
+        {'xid': 11, 'opcode': 'GET_CHILDREN2', 'path': '/a',
+         'watch': False},
+    ),
+    (
+        'DELETE',
+        b'\x00\x00\x00\x0c'               # xid = 12
+        b'\x00\x00\x00\x02'               # opcode DELETE = 2
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00\x00\x00\x07',              # version = 7
+        {'xid': 12, 'opcode': 'DELETE', 'path': '/a', 'version': 7},
+    ),
+    (
+        'SYNC',
+        b'\x00\x00\x00\x0d'               # xid = 13
+        b'\x00\x00\x00\x09'               # opcode SYNC = 9
+        b'\x00\x00\x00\x02/a',            # path
+        {'xid': 13, 'opcode': 'SYNC', 'path': '/a'},
+    ),
+    (
+        'PING',
+        # header-only request on the dedicated ping xid
+        # (reference: lib/zk-buffer.js:129-132, lib/zk-consts.js:136)
+        b'\xff\xff\xff\xfe'               # xid = XID_PING (-2)
+        b'\x00\x00\x00\x0b',              # opcode PING = 11
+        {'xid': -2, 'opcode': 'PING'},
+    ),
+    (
+        'CLOSE_SESSION',
+        # header-only; opcode is NEGATIVE (-11) on the wire
+        # (reference: lib/zk-consts.js OP_CODES, lib/zk-buffer.js:129)
+        b'\x00\x00\x00\x0e'               # xid = 14
+        b'\xff\xff\xff\xf5',              # opcode CLOSE_SESSION = -11
+        {'xid': 14, 'opcode': 'CLOSE_SESSION'},
+    ),
 ]
 
 # --- response fixtures (server -> client) ---
@@ -211,6 +268,105 @@ RESPONSE_FIXTURES = [
         b'\x00\x00\x00\x00',
         {'xid': -2, 'zxid': 21, 'err': 'OK', 'opcode': 'PING'},
     ),
+    (
+        'GET_DATA',
+        # buffer(data) then Stat (reference: lib/zk-buffer.js:353-357)
+        {9: 'GET_DATA'},
+        b'\x00\x00\x00\x09'
+        b'\x00\x00\x00\x00\x00\x00\x00\x16'   # zxid = 22
+        b'\x00\x00\x00\x00'
+        b'\x00\x00\x00\x05hello'              # data buffer
+        + STAT_BYTES,
+        {'xid': 9, 'zxid': 22, 'err': 'OK', 'opcode': 'GET_DATA',
+         'data': b'hello', 'stat': STAT},
+    ),
+    (
+        'GET_DATA-empty',
+        # a zero-byte znode rides the wire as length -1
+        # (reference: lib/jute-buffer.js:99-100,127-130)
+        {9: 'GET_DATA'},
+        b'\x00\x00\x00\x09'
+        b'\x00\x00\x00\x00\x00\x00\x00\x16'
+        b'\x00\x00\x00\x00'
+        b'\xff\xff\xff\xff'                   # data = empty (len -1)
+        + STAT_BYTES,
+        {'xid': 9, 'zxid': 22, 'err': 'OK', 'opcode': 'GET_DATA',
+         'data': b'', 'stat': STAT},
+    ),
+    (
+        'GET_CHILDREN',
+        # bare name list, NO stat (reference: lib/zk-buffer.js:333-344)
+        {10: 'GET_CHILDREN'},
+        b'\x00\x00\x00\x0a'
+        b'\x00\x00\x00\x00\x00\x00\x00\x17'   # zxid = 23
+        b'\x00\x00\x00\x00'
+        b'\x00\x00\x00\x03'                   # 3 children
+        b'\x00\x00\x00\x01a'
+        b'\x00\x00\x00\x02bb'
+        b'\x00\x00\x00\x03ccc',
+        {'xid': 10, 'zxid': 23, 'err': 'OK', 'opcode': 'GET_CHILDREN',
+         'children': ['a', 'bb', 'ccc']},
+    ),
+    (
+        'GET_CHILDREN2',
+        # name list THEN stat — the "2" variant's difference
+        {11: 'GET_CHILDREN2'},
+        b'\x00\x00\x00\x0b'
+        b'\x00\x00\x00\x00\x00\x00\x00\x18'   # zxid = 24
+        b'\x00\x00\x00\x00'
+        b'\x00\x00\x00\x01'                   # 1 child
+        b'\x00\x00\x00\x01a'
+        + STAT_BYTES,
+        {'xid': 11, 'zxid': 24, 'err': 'OK', 'opcode': 'GET_CHILDREN2',
+         'children': ['a'], 'stat': STAT},
+    ),
+    (
+        'DELETE',
+        # empty body: header error code alone carries the result
+        # (reference: lib/zk-buffer.js:316-325)
+        {12: 'DELETE'},
+        b'\x00\x00\x00\x0c'
+        b'\x00\x00\x00\x00\x00\x00\x00\x19'   # zxid = 25
+        b'\x00\x00\x00\x00',
+        {'xid': 12, 'zxid': 25, 'err': 'OK', 'opcode': 'DELETE'},
+    ),
+    (
+        'SYNC',
+        {13: 'SYNC'},
+        b'\x00\x00\x00\x0d'
+        b'\x00\x00\x00\x00\x00\x00\x00\x1a'   # zxid = 26
+        b'\x00\x00\x00\x00',
+        {'xid': 13, 'zxid': 26, 'err': 'OK', 'opcode': 'SYNC'},
+    ),
+    (
+        'CLOSE_SESSION',
+        {14: 'CLOSE_SESSION'},
+        b'\x00\x00\x00\x0e'
+        b'\x00\x00\x00\x00\x00\x00\x00\x1b'   # zxid = 27
+        b'\x00\x00\x00\x00',
+        {'xid': 14, 'zxid': 27, 'err': 'OK',
+         'opcode': 'CLOSE_SESSION'},
+    ),
+    (
+        'AUTH-ok',
+        # the authentication reply rides the dedicated xid -4
+        # (reference: lib/zk-consts.js:137, lib/zk-buffer.js:275-279)
+        {},
+        b'\xff\xff\xff\xfc'                   # xid = XID_AUTH (-4)
+        b'\x00\x00\x00\x00\x00\x00\x00\x1c'   # zxid = 28
+        b'\x00\x00\x00\x00',                  # err = OK, empty body
+        {'xid': -4, 'zxid': 28, 'err': 'OK', 'opcode': 'AUTH'},
+    ),
+    (
+        'AUTH-failed',
+        # AUTH_FAILED = -115 = 0xffffff8d (reference: lib/zk-consts.js)
+        {},
+        b'\xff\xff\xff\xfc'
+        b'\x00\x00\x00\x00\x00\x00\x00\x1c'
+        b'\xff\xff\xff\x8d',                  # err = AUTH_FAILED
+        {'xid': -4, 'zxid': 28, 'err': 'AUTH_FAILED',
+         'opcode': 'AUTH'},
+    ),
 ]
 
 
@@ -240,3 +396,189 @@ def test_response_decode_and_reencode(name, xid_map, wire, pkt):
     w = JuteWriter()
     records.write_response(w, dict(pkt))
     assert w.to_bytes() == wire
+
+
+# --- per-opcode error replies ---
+# An error reply is the 16-byte header alone; the error-code literals
+# below are transcribed from the reference's table
+# (lib/zk-consts.js:26-82) and certify the full numbering plus the
+# no-body-on-error rule (lib/zk-buffer.js:292,316-325) for EVERY
+# opcode.  Every error code in the table appears at least once.
+
+ERROR_REPLY_FIXTURES = [
+    # (opcode, error-code wire bytes, expected error name)
+    ('CREATE', b'\xff\xff\xff\x92', 'NODE_EXISTS'),            # -110
+    ('CREATE', b'\xff\xff\xff\x8e', 'INVALID_ACL'),            # -114
+    ('CREATE', b'\xff\xff\xff\x94',
+     'NO_CHILDREN_FOR_EPHEMERALS'),                            # -108
+    ('DELETE', b'\xff\xff\xff\x91', 'NOT_EMPTY'),              # -111
+    ('DELETE', b'\xff\xff\xff\x99', 'BAD_VERSION'),            # -103
+    ('SET_DATA', b'\xff\xff\xff\x99', 'BAD_VERSION'),
+    ('SET_DATA', b'\xff\xff\xff\xfb', 'MARSHALLING_ERROR'),    # -5
+    ('GET_DATA', b'\xff\xff\xff\x9b', 'NO_NODE'),              # -101
+    ('GET_DATA', b'\xff\xff\xff\x9a', 'NO_AUTH'),              # -102
+    ('EXISTS', b'\xff\xff\xff\x9b', 'NO_NODE'),
+    ('GET_ACL', b'\xff\xff\xff\x9b', 'NO_NODE'),
+    ('GET_CHILDREN', b'\xff\xff\xff\x9b', 'NO_NODE'),
+    ('GET_CHILDREN2', b'\xff\xff\xff\x9b', 'NO_NODE'),
+    ('GET_CHILDREN2', b'\xff\xff\xff\x9c', 'API_ERROR'),       # -100
+    ('SYNC', b'\xff\xff\xff\xfc', 'CONNECTION_LOSS'),          # -4
+    ('SYNC', b'\xff\xff\xff\xf9', 'OPERATION_TIMEOUT'),        # -7
+    ('SET_WATCHES', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),   # -112
+    ('PING', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),
+    ('CLOSE_SESSION', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),
+    ('AUTH', b'\xff\xff\xff\x8d', 'AUTH_FAILED'),              # -115
+    ('EXISTS', b'\xff\xff\xff\xff', 'SYSTEM_ERROR'),           # -1
+    ('EXISTS', b'\xff\xff\xff\xfe', 'RUNTIME_INCONSISTENCY'),  # -2
+    ('EXISTS', b'\xff\xff\xff\xfd', 'DATA_INCONSISTENCY'),     # -3
+    ('EXISTS', b'\xff\xff\xff\xfa', 'UNIMPLEMENTED'),          # -6
+    ('EXISTS', b'\xff\xff\xff\xf8', 'BAD_ARGUMENTS'),          # -8
+    ('EXISTS', b'\xff\xff\xff\x8f', 'INVALID_CALLBACK'),       # -113
+]
+
+#: xids for the error-reply header: special opcodes use their reserved
+#: xid (reference: lib/zk-consts.js:135-138), the rest an ordinary one
+_SPECIAL_REPLY_XIDS = {'PING': b'\xff\xff\xff\xfe',
+                       'AUTH': b'\xff\xff\xff\xfc',
+                       'SET_WATCHES': b'\xff\xff\xff\xf8',
+                       'NOTIFICATION': b'\xff\xff\xff\xff'}
+
+
+@pytest.mark.parametrize(
+    'opcode,err_bytes,err_name', ERROR_REPLY_FIXTURES,
+    ids=['%s-%s' % (f[0], f[2]) for f in ERROR_REPLY_FIXTURES])
+def test_error_reply_decode_and_reencode(opcode, err_bytes, err_name):
+    xid_bytes = _SPECIAL_REPLY_XIDS.get(opcode, b'\x00\x00\x00\x21')
+    xid = int.from_bytes(xid_bytes, 'big', signed=True)
+    wire = (xid_bytes
+            + b'\x00\x00\x00\x00\x00\x00\x00\x2a'   # zxid = 42
+            + err_bytes)
+    xid_map = {} if xid < 0 else {xid: opcode}
+    r = JuteReader(wire)
+    got = records.read_response(r, dict(xid_map))
+    assert r.at_end()
+    # exactly the header fields — an error reply must carry NO body
+    assert got == {'xid': xid, 'zxid': 42, 'err': err_name,
+                   'opcode': opcode}
+
+    w = JuteWriter()
+    records.write_response(w, dict(got))
+    assert w.to_bytes() == wire
+
+
+def test_error_reply_fixtures_cover_every_error_code():
+    """The table above certifies the COMPLETE error numbering: every
+    code the protocol defines (reference: lib/zk-consts.js:26-82)
+    appears in at least one hand-assembled error reply."""
+    from zkstream_tpu.protocol.consts import ErrCode
+
+    covered = {f[2] for f in ERROR_REPLY_FIXTURES}
+    # EXISTS-no-node in RESPONSE_FIXTURES covers NO_NODE too; OK is
+    # every success fixture
+    assert covered | {'OK'} == {e.name for e in ErrCode}
+
+
+# --- connect handshake fixtures (reference: lib/zk-buffer.js:22-56) ---
+
+CONNECT_REQUEST_RESUME = (
+    b'\x00\x00\x00\x00'                   # protocolVersion = 0
+    b'\x11\x22\x33\x44\x55\x66\x77\x88'   # lastZxidSeen
+    b'\x00\x00\x75\x30'                   # timeOut = 30000
+    b'\x1f\xaf\x00\x00\x00\x00\x00\x01'   # sessionId (resume)
+    b'\x00\x00\x00\x10'                   # passwd: 16-byte buffer
+    b'\x00\x01\x02\x03\x04\x05\x06\x07'
+    b'\x08\x09\x0a\x0b\x0c\x0d\x0e\x0f'
+)
+
+CONNECT_REQUEST_RESUME_PKT = {
+    'protocolVersion': 0, 'lastZxidSeen': 0x1122334455667788,
+    'timeOut': 30000, 'sessionId': 0x1FAF000000000001,
+    'passwd': bytes(range(16)),
+}
+
+CONNECT_RESPONSE = (
+    b'\x00\x00\x00\x00'                   # protocolVersion = 0
+    b'\x00\x00\x9c\x40'                   # timeOut = 40000 (renegotiated)
+    b'\x1f\xaf\x00\x00\x00\x00\x00\x01'   # sessionId
+    b'\x00\x00\x00\x10'                   # passwd
+    b'\x00\x01\x02\x03\x04\x05\x06\x07'
+    b'\x08\x09\x0a\x0b\x0c\x0d\x0e\x0f'
+)
+
+CONNECT_RESPONSE_PKT = {
+    'protocolVersion': 0, 'timeOut': 40000,
+    'sessionId': 0x1FAF000000000001, 'passwd': bytes(range(16)),
+}
+
+CONNECT_RESPONSE_EXPIRED = (
+    # session-expired handshake: zero sessionId, zeroed passwd
+    # (reference behavior: lib/zk-session.js:170-173 keys off sid==0)
+    b'\x00\x00\x00\x00'
+    b'\x00\x00\x75\x30'
+    b'\x00\x00\x00\x00\x00\x00\x00\x00'   # sessionId = 0
+    b'\x00\x00\x00\x10' + b'\x00' * 16
+)
+
+
+def test_connect_request_decode_and_reencode():
+    r = JuteReader(CONNECT_REQUEST_RESUME)
+    got = records.read_connect_request(r)
+    assert r.at_end()
+    assert got == CONNECT_REQUEST_RESUME_PKT
+    w = JuteWriter()
+    records.write_connect_request(w, dict(got))
+    assert w.to_bytes() == CONNECT_REQUEST_RESUME
+
+
+def test_connect_response_decode_and_reencode():
+    r = JuteReader(CONNECT_RESPONSE)
+    got = records.read_connect_response(r)
+    assert r.at_end()
+    assert got == CONNECT_RESPONSE_PKT
+    w = JuteWriter()
+    records.write_connect_response(w, dict(got))
+    assert w.to_bytes() == CONNECT_RESPONSE
+
+    r = JuteReader(CONNECT_RESPONSE_EXPIRED)
+    got = records.read_connect_response(r)
+    assert got['sessionId'] == 0 and got['passwd'] == b'\x00' * 16
+
+
+@pytest.mark.parametrize('ro_byte', [b'', b'\x00', b'\x01'],
+                         ids=['absent', 'readonly-0', 'readonly-1'])
+def test_connect_handshake_readonly_byte_tolerated(ro_byte):
+    """ZooKeeper 3.4+ appends a readOnly bool to both handshake
+    messages; 3.3 omits it.  The reference reads only the four fixed
+    fields and ignores any trailing byte (lib/zk-buffer.js:22-56 reads
+    exactly four fields; the decode stream discards the remainder) —
+    the full receive path here must accept all three framings."""
+    from zkstream_tpu.protocol.framing import PacketCodec, frame
+
+    client = PacketCodec()                 # decoding a ConnectResponse
+    pkts = client.decode(frame(CONNECT_RESPONSE + ro_byte))
+    assert pkts == [CONNECT_RESPONSE_PKT]
+
+    server = PacketCodec(server=True)      # decoding a ConnectRequest
+    pkts = server.decode(frame(CONNECT_REQUEST_RESUME + ro_byte))
+    assert pkts == [CONNECT_REQUEST_RESUME_PKT]
+
+
+def test_fixture_corpus_covers_every_opcode_both_directions():
+    """The corpus's completeness is itself under test: every request
+    opcode the codec speaks appears in a hand-assembled request
+    fixture, and every reply opcode (success or error) in a
+    hand-assembled response fixture — so a new opcode cannot land
+    without independent bytes certifying it."""
+    from zkstream_tpu.protocol.records import (
+        _EMPTY_RESPONSES,
+        _REQ_READERS,
+        _RESP_READERS,
+    )
+
+    req_covered = {pkt['opcode'] for _n, _w, pkt in REQUEST_FIXTURES}
+    assert req_covered == set(_REQ_READERS)
+
+    resp_covered = {pkt['opcode']
+                    for _n, _m, _w, pkt in RESPONSE_FIXTURES}
+    resp_covered |= {f[0] for f in ERROR_REPLY_FIXTURES}
+    assert resp_covered == set(_RESP_READERS) | set(_EMPTY_RESPONSES)

@@ -85,9 +85,20 @@ class Logger:
         """Error-level log with the ACTIVE exception's traceback
         appended — for except-blocks that swallow an error to keep a
         loop alive (e.g. the multihost cadence) but must not hide it."""
+        if not self.base.isEnabledFor(_logging.ERROR):
+            return
         import traceback
-        self._log(_logging.ERROR,
-                  msg + '\n%s', *(args + (traceback.format_exc(),)))
+
+        # render the caller's args FIRST so a literal '%' in the
+        # rendered message cannot collide with the traceback's %s slot
+        # (same invariant _log keeps for context suffixes)
+        if args:
+            try:
+                msg = msg % args
+            except (TypeError, ValueError):
+                msg = '%s %r' % (msg, args)
+        self._log(_logging.ERROR, '%s\n%s', msg,
+                  traceback.format_exc())
 
     def fatal(self, msg: str, *args) -> None:
         """Bunyan's top level (the reference logs at fatal before
