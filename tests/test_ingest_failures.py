@@ -1,0 +1,344 @@
+"""FleetIngest failure-path coverage (VERDICT r3 Next #8): the code
+that only runs when things go wrong — compile-failure latch, placement
+probe fallbacks, loop-closed-mid-compile, torn-down-mid-tick
+connections, unmatched xids, unsupported reply opcodes, and the C-slice
+error wrap.  Driven through lightweight fake connections so each path
+is hit deterministically, with asserts on observable behavior (what got
+delivered / counted), not line touches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+
+from zkstream_tpu.io.ingest import FleetIngest
+from zkstream_tpu.protocol.errors import ZKProtocolError
+from zkstream_tpu.protocol.framing import PacketCodec, frame
+from zkstream_tpu.protocol.jute import JuteWriter
+from zkstream_tpu.protocol.records import Stat, write_response
+
+
+class FakeConn:
+    """The slice of ZKConnection the ingest touches: a codec, a state
+    probe, and the 'ingestDeliver' emitter."""
+
+    def __init__(self, use_native=False):
+        self.codec = PacketCodec(use_native=use_native)
+        self.codec.handshaking = False
+        self.delivered: list = []
+        self.on_deliver = None
+        self.state = 'connected'
+
+    def is_in_state(self, s):
+        return self.state == s
+
+    def emit(self, name, *args):
+        assert name == 'ingestDeliver'
+        self.delivered.append(args)
+        if self.on_deliver is not None:
+            self.on_deliver(self)
+
+
+def reply_frame(xid, opcode='PING', zxid=7, **body) -> bytes:
+    w = JuteWriter()
+    write_response(w, {'xid': xid, 'zxid': zxid, 'err': 'OK',
+                       'opcode': opcode, **body})
+    return frame(w.to_bytes())
+
+
+def mk_ingest(**kw):
+    kw.setdefault('bypass_bytes', 0)
+    kw.setdefault('warm', 'block')
+    kw.setdefault('min_len', 256)
+    kw.setdefault('max_frames', 4)
+    return FleetIngest(**kw)
+
+
+async def drain():
+    """Run the call_soon-scheduled tick."""
+    await asyncio.sleep(0)
+    await asyncio.sleep(0)
+
+
+async def test_compile_failure_latches_bucket_to_scalar():
+    """A failed XLA compile must latch that bucket onto the scalar
+    drain (never retry-compile, never lose traffic) — warm='block'."""
+    ing = mk_ingest()
+    ing._compile = lambda key: (_ for _ in ()).throw(
+        RuntimeError('injected compile failure'))
+    conn = FakeConn()
+    ing.register(conn)
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    # delivered through the codec anyway, and the bucket is poisoned
+    assert conn.delivered[0][0][0]['opcode'] == 'PING'
+    assert list(ing._exec.values()) == [None]
+    before = ing.ticks_scalar
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    assert ing.ticks_scalar == before + 1   # stays scalar, no retry
+    assert ing.ticks == 0
+
+
+async def test_background_compile_failure_unblocks_prewarm():
+    """warm='background': a failing compile still sets the warm event
+    (None latched), so prewarm callers do not hang."""
+    ing = mk_ingest(warm='background')
+    ing._compile = lambda key: (_ for _ in ()).throw(
+        RuntimeError('injected compile failure'))
+    await asyncio.wait_for(ing.prewarm(4), timeout=10)
+    assert list(ing._exec.values()) == [None]
+    # traffic flows scalar through the latched bucket
+    conn = FakeConn()
+    ing.register(conn)
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    assert conn.delivered[0][0][0]['opcode'] == 'PING'
+    assert ing.ticks == 0 and ing.ticks_scalar == 1
+
+
+def test_loop_closed_mid_compile_is_contained():
+    """The background warm thread surviving its event loop: the
+    call_soon_threadsafe on a closed loop raises RuntimeError, which
+    must be swallowed (the process is shutting down; nothing to do).
+    Sync test: it owns its own short-lived loop."""
+    ing = mk_ingest(warm='background')
+    release = threading.Event()
+    done = threading.Event()
+
+    def slow_compile(key):
+        release.wait(10)
+        done.set()
+        return None
+
+    ing._compile = slow_compile
+
+    async def kick():
+        ing._start_warm((False, 8, 256))
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(kick())
+    finally:
+        loop.close()          # close BEFORE the compile finishes
+    release.set()
+    assert done.wait(10)
+    ing._warm_pool.shutdown(wait=True)   # worker exits cleanly
+    # the result could not be delivered: the bucket is still unwarmed
+    assert ing._exec == {}
+
+
+async def test_warming_tick_defers_scalar_then_flips_to_device():
+    """warm='background' handoff: ticks before the compile lands drain
+    scalar (counted as warming), and the ready callback re-schedules so
+    queued bytes flow through the device program."""
+    ing = mk_ingest(warm='background')
+    conn = FakeConn()
+    ing.register(conn)
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    assert ing.ticks_warming == 1
+    assert conn.delivered[0][0][0]['opcode'] == 'PING'
+    # wait for the single bucket to finish compiling
+    ev = next(iter(ing._warm_events.values()))
+    await asyncio.wait_for(ev.wait(), timeout=30)
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    assert ing.ticks == 1                  # device path engaged
+    assert conn.delivered[1][0][0]['opcode'] == 'PING'
+
+
+async def test_register_migrates_codec_residue():
+    """A partial steady-state frame that rode the same TCP segment as
+    the handshake must migrate from the scalar decoder into the slot
+    (no stranded bytes), and complete once the rest arrives."""
+    ing = mk_ingest()
+    conn = FakeConn()
+    wire = reply_frame(-2)
+    conn.codec.restore_pending(wire[:5])   # partial frame in the codec
+    ing.register(conn)
+    assert bytes(ing._slots[id(conn)][1]) == wire[:5]
+    ing.feed(conn, wire[5:])
+    await drain()
+    assert conn.delivered[0][0][0]['opcode'] == 'PING'
+
+
+async def test_feed_after_unregister_is_dropped():
+    ing = mk_ingest()
+    conn = FakeConn()
+    ing.register(conn)
+    ing.unregister(conn)
+    ing.feed(conn, reply_frame(-2))        # raced a teardown: no slot
+    await drain()
+    assert conn.delivered == []
+
+
+async def test_unregister_restores_pending_bytes_to_codec():
+    ing = mk_ingest()
+    conn = FakeConn()
+    ing.register(conn)
+    wire = reply_frame(-2)
+    ing.feed(conn, wire[:5])
+    ing.unregister(conn)
+    # the closing state keeps draining through the codec
+    pkts = conn.codec.decode(wire[5:])
+    assert pkts[0]['opcode'] == 'PING'
+
+
+async def test_placement_host_pins_cpu_and_accelerator_skips():
+    ing = mk_ingest(placement='host')
+    ing._resolve_placement()
+    assert ing._device is not None and ing._device.platform == 'cpu'
+    ing2 = mk_ingest(placement='accelerator')
+    ing2._resolve_placement()
+    assert ing2._device is None
+
+
+async def test_placement_survives_missing_cpu_backend():
+    """The latency optimization must never break the runtime: if the
+    host CPU backend cannot initialize, ticks stay on the default
+    device with a warning."""
+    ing = mk_ingest(placement='host')
+    ing._cpu_device = lambda timeout_s=15.0: None
+    ing._resolve_placement()
+    assert ing._device is None             # stayed on default
+    # and the probe runs at most once
+    ing._resolve_placement()
+
+
+async def test_placement_auto_probes_and_falls_back():
+    """placement='auto' on a non-CPU default backend measures the
+    dispatch+readback RTT and pins ticks to the host CPU backend when
+    it exceeds the budget (the tunneled-TPU case)."""
+    from unittest import mock
+
+    ing = mk_ingest(placement='auto', latency_budget_ms=-1.0)
+    with mock.patch('jax.default_backend', return_value='tpu'):
+        ing._resolve_placement()
+    # any real RTT beats a negative budget: fell back to host
+    assert ing._device is not None and ing._device.platform == 'cpu'
+
+
+async def test_unmatched_reply_xid_is_bad_decode():
+    """A reply xid matching no request surfaces the same BAD_DECODE
+    the scalar codec raises (framing.py parity)."""
+    ing = mk_ingest()
+    conn = FakeConn()
+    ing.register(conn)
+    ing.feed(conn, reply_frame(31337))     # nothing in xid_map
+    await drain()
+    pkts, err = conn.delivered[0]
+    assert pkts == []
+    assert isinstance(err, ZKProtocolError) and err.code == 'BAD_DECODE'
+    assert 'matches no request' in str(err)
+
+
+async def test_unsupported_reply_opcode_is_bad_decode():
+    ing = mk_ingest()
+    conn = FakeConn()
+    conn.codec.xid_map[9] = 'SET_ACL'      # decodable header, no reader
+    ing.register(conn)
+    w = JuteWriter()
+    w.write_struct(struct.Struct('>iqi'), 9, 7, 0)
+    w.write_ustring('/x')
+    ing.feed(conn, frame(w.to_bytes()))
+    await drain()
+    pkts, err = conn.delivered[0]
+    assert pkts == []
+    assert isinstance(err, ZKProtocolError) and err.code == 'BAD_DECODE'
+
+
+async def test_ext_slice_failure_wraps_as_bad_decode():
+    """body_mode='host' C fast path: an exception out of the extension
+    becomes connection-level BAD_DECODE, not a raw crash."""
+    ing = mk_ingest()
+    conn = FakeConn()
+
+    class BrokenExt:
+        def decode_responses(self, buf, xid_map, max_packet):
+            raise MemoryError('injected')
+
+    conn.codec._ext = BrokenExt()
+    ing.register(conn)
+    ing.feed(conn, reply_frame(-2))
+    await drain()
+    pkts, err = conn.delivered[0]
+    assert pkts == []
+    assert isinstance(err, ZKProtocolError) and err.code == 'BAD_DECODE'
+    assert 'MemoryError' in str(err)
+
+
+async def test_bypass_scalar_error_delivers_prior_packets():
+    """The small-tick bypass drains through the codec: a frame with an
+    undecodable BODY mid-chunk delivers the packets before it plus the
+    error (the scalar drain's contract, test_native_ext's
+    bad-body case), and a bad LENGTH prefix surfaces BAD_LENGTH."""
+    ing = mk_ingest(bypass_bytes=1 << 20)   # force the bypass path
+    conn = FakeConn()
+    ing.register(conn)
+    # valid framing, body truncated mid-stat
+    bad_body = struct.pack('>iqi', 2, 5, 0) + b'\x00' * 4
+    conn.codec.xid_map[2] = 'EXISTS'
+    ing.feed(conn, reply_frame(-2) + frame(bad_body))
+    await drain()
+    pkts, err = conn.delivered[0]
+    assert [p['opcode'] for p in pkts] == ['PING']
+    assert isinstance(err, ZKProtocolError) and err.code == 'BAD_DECODE'
+
+    conn2 = FakeConn()
+    ing.register(conn2)
+    ing.feed(conn2, struct.pack('>i', -5))  # negative length prefix
+    await drain()
+    pkts, err = conn2.delivered[0]
+    assert pkts == []
+    assert isinstance(err, ZKProtocolError) and err.code == 'BAD_LENGTH'
+
+
+async def test_teardown_mid_tick_skips_dead_connection():
+    """A delivery callback tearing down ANOTHER connection mid-tick:
+    the torn-down conn is skipped on every drain loop (bypass, warming,
+    device) and its bytes returned to its codec."""
+    for setup in ('bypass', 'warming', 'device'):
+        ing = mk_ingest(
+            bypass_bytes=(1 << 20) if setup == 'bypass' else 0,
+            warm='background' if setup == 'warming' else 'block')
+        if setup == 'device':
+            await ing.prewarm(8)
+        a, b = FakeConn(), FakeConn()
+        ing.register(a)
+        ing.register(b)
+
+        def kill_b(_conn):
+            ing.unregister(b)
+        a.on_deliver = kill_b
+        ing.feed(conn=a, data=reply_frame(-2))
+        ing.feed(conn=b, data=reply_frame(-2))
+        await drain()
+        assert a.delivered and a.delivered[0][0][0]['opcode'] == 'PING'
+        # b was skipped; its bytes went back to its codec intact
+        assert b.delivered == [], setup
+        assert id(b) not in ing._slots
+
+
+async def test_oversized_device_body_falls_back_to_scalar_reader():
+    """body_mode='device': a data field wider than the tensor plane
+    must fall back to the scalar reader per frame (counted), with the
+    identical packet delivered."""
+    ing = mk_ingest(body_mode='device', max_data=8, max_path=16,
+                    max_frames=2)
+    conn = FakeConn()
+    conn.codec.xid_map[5] = 'GET_DATA'
+    conn.codec.xid_map[6] = 'GET_DATA'
+    ing.register(conn)
+    st = Stat(czxid=1, mzxid=2, pzxid=3)
+    wire = reply_frame(5, 'GET_DATA', data=b'x' * 32, stat=st)  # > 8
+    wire += reply_frame(6, 'GET_DATA', data=b'ok', stat=st)     # fits
+    ing.feed(conn, wire)
+    await drain()
+    pkts, err = conn.delivered[0]
+    assert err is None
+    assert pkts[0]['data'] == b'x' * 32    # scalar fallback, correct
+    assert pkts[1]['data'] == b'ok'        # device plane
+    assert ing.body_fallbacks == 1

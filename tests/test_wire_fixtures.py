@@ -437,11 +437,12 @@ ERROR_REPLY_FIXTURES = [
 ]
 
 #: xids for the error-reply header: special opcodes use their reserved
-#: xid (reference: lib/zk-consts.js:135-138), the rest an ordinary one
+#: xid (reference: lib/zk-consts.js:135-138), the rest an ordinary
+#: one.  (NOTIFICATION is absent: watch events have no error-reply
+#: form in the protocol.)
 _SPECIAL_REPLY_XIDS = {'PING': b'\xff\xff\xff\xfe',
                        'AUTH': b'\xff\xff\xff\xfc',
-                       'SET_WATCHES': b'\xff\xff\xff\xf8',
-                       'NOTIFICATION': b'\xff\xff\xff\xff'}
+                       'SET_WATCHES': b'\xff\xff\xff\xf8'}
 
 
 @pytest.mark.parametrize(

@@ -763,15 +763,25 @@ class FleetIngest:
         resid = int(st.resid[i])
         if not resid:
             return [], None
+        mv = memoryview(buf)
+        sl = mv[:resid]
         try:
             pkts, _consumed, kind, msg = ext.decode_responses(
-                memoryview(buf)[:resid], conn.codec.xid_map, MAX_PACKET)
+                sl, conn.codec.xid_map, MAX_PACKET)
         except Exception as e:
             err = ZKProtocolError('BAD_DECODE',
                 'Failed to decode Response: %s: %s'
                 % (type(e).__name__, e))
             err.__cause__ = e
             return [], err
+        finally:
+            # Release the views NOW: an exception's traceback (kept
+            # alive via err.__cause__) can pin the call frame and with
+            # it the buffer export, and an exported bytearray cannot
+            # be resized — the caller's `del buf[:resid]` would raise
+            # BufferError and kill the whole tick.
+            sl.release()
+            mv.release()
         if kind is not None:
             return pkts, ZKProtocolError(kind, msg)
         return pkts, None

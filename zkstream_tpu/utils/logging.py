@@ -43,19 +43,26 @@ class Logger:
         (the analogue of bunyan's ``log.child({...})``)."""
         return Logger(self.base, {**self.context, **ctx})
 
-    def _log(self, level: int, msg: str, *args) -> None:
-        if not self.base.isEnabledFor(level):
-            return
-        # Render args BEFORE appending the context suffix: a context
-        # value containing '%' (e.g. an IPv6 zone id in zkAddress) must
-        # not be interpreted as a format directive.  A format/arg
-        # mismatch must stay contained like stdlib logging's deferred
-        # formatting would — never raise into an FSM state handler.
+    @staticmethod
+    def _render(msg: str, args: tuple) -> str:
+        """Render ``msg % args`` with the mismatch fallback both _log
+        and exception() share.  A format/arg mismatch must stay
+        contained like stdlib logging's deferred formatting would —
+        never raise into an FSM state handler."""
         if args:
             try:
                 msg = msg % args
             except (TypeError, ValueError):
                 msg = '%s %r' % (msg, args)
+        return msg
+
+    def _log(self, level: int, msg: str, *args) -> None:
+        if not self.base.isEnabledFor(level):
+            return
+        # Render args BEFORE appending the context suffix: a context
+        # value containing '%' (e.g. an IPv6 zone id in zkAddress) must
+        # not be interpreted as a format directive.
+        msg = self._render(msg, args)
         if self.context:
             msg += ' [%s]' % ' '.join(
                 '%s=%s' % (k, v) for k, v in self.context.items())
@@ -92,12 +99,7 @@ class Logger:
         # render the caller's args FIRST so a literal '%' in the
         # rendered message cannot collide with the traceback's %s slot
         # (same invariant _log keeps for context suffixes)
-        if args:
-            try:
-                msg = msg % args
-            except (TypeError, ValueError):
-                msg = '%s %r' % (msg, args)
-        self._log(_logging.ERROR, '%s\n%s', msg,
+        self._log(_logging.ERROR, '%s\n%s', self._render(msg, args),
                   traceback.format_exc())
 
     def fatal(self, msg: str, *args) -> None:
