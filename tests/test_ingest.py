@@ -118,21 +118,25 @@ async def test_ingest_semantics_match_scalar_drain():
 
 
 async def test_ingest_small_tick_bypass():
-    """With the default crossover enabled, small ticks drain through
-    the scalar codec (no device dispatch) with identical semantics;
-    the device pipeline engages only past the byte threshold."""
+    """With the default crossover enabled, small-volume traffic runs
+    as a pass-through (no device dispatch, no batching overhead) with
+    identical semantics; the device pipeline engages once the observed
+    bytes-per-tick cross the threshold."""
     ingest = FleetIngest(body_mode='host', max_frames=8,
                          warm='block')  # default bypass
     assert ingest.bypass_bytes > 0
+    assert ingest._direct              # starts in pass-through
     scalar = await _run_mode(None)
     got = await _run_mode(ingest)
     assert got == scalar
-    assert ingest.ticks_scalar > 0     # small ticks took the bypass
+    assert ingest.ticks_scalar > 0     # traffic rode the pass-through
     assert ingest.ticks == 0           # nothing crossed the threshold
     assert ingest.frames_routed > 0    # and traffic was still counted
+    assert ingest._direct              # never left the regime
 
-    # force a tick over the threshold: every buffered byte beyond
-    # bypass_bytes must go through the device path
+    # cross the threshold: once the per-tick volume is observed above
+    # bypass_bytes (one window of hysteresis), traffic flows through
+    # the device path
     big = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=64,
                       warm='block')
     srv = await ZKServer().start()
@@ -140,9 +144,11 @@ async def test_ingest_small_tick_bypass():
     try:
         await c.wait_connected(timeout=5)
         await c.create('/blob', b'z' * 300)
-        data, _stat = await c.get('/blob')   # 300B reply > 64B threshold
-        assert data == b'z' * 300
-        assert big.ticks > 0
+        for _ in range(3):                   # 300B replies > 64B
+            data, _stat = await c.get('/blob')
+            assert data == b'z' * 300
+        assert not big._direct               # regime flipped to batch
+        assert big.ticks > 0                 # device path engaged
     finally:
         await c.close()
         await srv.stop()

@@ -151,10 +151,11 @@ async def test_warming_tick_defers_scalar_then_flips_to_device():
 
 
 async def test_register_migrates_codec_residue():
-    """A partial steady-state frame that rode the same TCP segment as
-    the handshake must migrate from the scalar decoder into the slot
-    (no stranded bytes), and complete once the rest arrives."""
-    ing = mk_ingest()
+    """BATCH regime: a partial steady-state frame that rode the same
+    TCP segment as the handshake must migrate from the scalar decoder
+    into the slot (the tick scan owns the stream), and complete once
+    the rest arrives."""
+    ing = mk_ingest()                      # bypass 0 -> batch regime
     conn = FakeConn()
     wire = reply_frame(-2)
     conn.codec.restore_pending(wire[:5])   # partial frame in the codec
@@ -163,6 +164,28 @@ async def test_register_migrates_codec_residue():
     ing.feed(conn, wire[5:])
     await drain()
     assert conn.delivered[0][0][0]['opcode'] == 'PING'
+
+
+async def test_register_direct_regime_leaves_residue_in_codec():
+    """DIRECT regime (the shipped default at startup): the codec keeps
+    draining the stream itself, so handshake-coincident residue must
+    STAY in the codec — migrating it into a slot nothing drains would
+    strand it and misframe every later byte (r4 regression: the
+    connection died with BAD_LENGTH on the next read)."""
+    ing = mk_ingest(bypass_bytes=16384)
+    assert ing._direct
+    conn = FakeConn()
+    wire = reply_frame(-2) + reply_frame(-2)
+    conn.codec.restore_pending(wire[:5])
+    ing.register(conn)
+    assert bytes(ing._slots[id(conn)][1]) == b''   # slot stays empty
+    # the connection-side direct drain continues the partial frame
+    # exactly where the codec left off
+    pkts = conn.codec.decode(wire[5:])
+    ing.note_direct(len(wire) - 5, len(pkts))
+    assert [p['opcode'] for p in pkts] == ['PING', 'PING']
+    await drain()
+    assert ing.frames_routed == 2
 
 
 async def test_feed_after_unregister_is_dropped():
@@ -342,3 +365,46 @@ async def test_oversized_device_body_falls_back_to_scalar_reader():
     assert pkts[0]['data'] == b'x' * 32    # scalar fallback, correct
     assert pkts[1]['data'] == b'ok'        # device plane
     assert ing.body_fallbacks == 1
+
+
+async def test_fragmentation_guard_enters_and_exits():
+    """The upper dispatch guard (CROSSOVER.md's 1,024-conn losing
+    regime): a large fleet whose ticks are sparse routes to the scalar
+    drain; when ticks become batches again the device path resumes —
+    with hysteresis in between."""
+    ing = mk_ingest()             # bypass_bytes=0, warm='block'
+    ing.FRAG_MIN_FLEET = 8        # scale the guard to a test fleet
+    await ing.prewarm(8)
+    conns = [FakeConn() for _ in range(8)]
+    for c in conns:
+        ing.register(c)
+
+    # synchronized bursts: every conn delivers every tick -> device
+    for _ in range(4):
+        for c in conns:
+            ing.feed(c, reply_frame(-2))
+        await drain()
+    assert ing.ticks >= 4 and ing.ticks_frag == 0
+
+    # fragmented: one frame per tick over an 8-conn fleet -> the EMA
+    # decays below FRAG_ENTER * 8 = 2 and the guard engages
+    for i in range(16):
+        ing.feed(conns[i % 8], reply_frame(-2))
+        await drain()
+    assert ing.ticks_frag > 0
+    assert ing._frag_scalar
+    frag_at = ing.ticks_frag
+    # every frame still delivered, through whichever path
+    assert ing.frames_routed == 4 * 8 + 16
+
+    # batches return: EMA recovers past FRAG_EXIT * 8 and device
+    # ticks resume (a couple of guarded ticks while the EMA climbs is
+    # the hysteresis working)
+    device_before = ing.ticks
+    for _ in range(8):
+        for c in conns:
+            ing.feed(c, reply_frame(-2))
+        await drain()
+    assert not ing._frag_scalar
+    assert ing.ticks_frag <= frag_at + 3
+    assert ing.ticks > device_before     # device path resumed

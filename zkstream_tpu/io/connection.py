@@ -296,14 +296,37 @@ class ZKConnection(FSM):
                 S.goto_state('error')
 
         if self.ingest is not None:
-            # Fleet drain: bytes go to the batched device pipeline; the
-            # ingest routes the decoded packets back through the same
-            # deliver path, so semantics cannot diverge from the scalar
-            # drain below.
+            # Fleet drain: in the ingest's BATCH regime bytes go to the
+            # batched device pipeline, which routes the decoded packets
+            # back through the same deliver path, so semantics cannot
+            # diverge from the scalar drain below.  In its pass-through
+            # (direct) regime the connection runs the per-socket drain
+            # itself — the ingest only gets the byte/frame counts its
+            # dispatch policy needs — so the regime where batching does
+            # not pay costs one flag check over the no-ingest path.
             self.ingest.register(self)
             S.defer(lambda: self.ingest.unregister(self))
-            S.on(self, 'sockData',
-                 lambda data: self.ingest.feed(self, data))
+
+            def on_sock(data):
+                ing = self.ingest
+                if not ing.direct:
+                    ing.feed(self, data)
+                    return
+                # Deliberately restates FleetIngest._deliver_direct
+                # minus its emit hop: calling deliver() directly here
+                # skips one event dispatch per segment, which is the
+                # point of the pass-through.  Slot residue cannot
+                # exist in this regime (register/flip keep it in the
+                # codec), so no splice is needed.
+                err = None
+                try:
+                    pkts = self.codec.decode(data)
+                except ZKProtocolError as e:
+                    pkts = getattr(e, 'packets', [])
+                    err = e
+                ing.note_direct(len(data), len(pkts))
+                deliver(pkts, err)
+            S.on(self, 'sockData', on_sock)
             S.on(self, 'ingestDeliver', deliver)
         else:
             def on_data(data):

@@ -108,8 +108,21 @@ class FleetIngest:
         XLA program compiles on a daemon thread, so the event loop
         never blocks on a compile; ``'block'`` — compile inline on
         first use (deterministic; tests/tools).
+      frag_guard: route fragmented mega-fleet ticks back to the scalar
+        drain (see the attribute comment below).  Default True; the
+        mesh proxy disables it.
       log: parent logger.
     """
+
+    #: Fragmentation-guard calibration (CROSSOVER.md, 1,024-conn
+    #: cells): engage only for fleets at least this large...
+    FRAG_MIN_FLEET = 600
+    #: ...entering scalar routing when the frames-per-tick EMA drops
+    #: below ENTER x fleet size (ticks stopped being batches), leaving
+    #: it again above EXIT x fleet size (hysteresis so the router
+    #: cannot flap on tick-to-tick noise).
+    FRAG_ENTER = 0.25
+    FRAG_EXIT = 0.40
 
     def __init__(self, max_frames: int = 32, body_mode: str = 'host',
                  max_data: int = 256, max_path: int = 256,
@@ -120,6 +133,7 @@ class FleetIngest:
                  latency_budget_ms: float = 5.0,
                  bypass_bytes: int = 16384,
                  warm: str = 'background',
+                 frag_guard: bool = True,
                  log: Logger | None = None):
         assert body_mode in ('host', 'device'), body_mode
         assert placement in ('auto', 'accelerator', 'host'), placement
@@ -138,17 +152,20 @@ class FleetIngest:
         self.max_id = max_id
         self.min_len = min_len
         self.warm = warm
-        #: Small-tick crossover: when a tick holds fewer than this many
-        #: buffered wire bytes in total, the batch dispatch + readback
-        #: costs more than it saves, so the tick drains each stream
-        #: through its connection's own scalar codec (C-accelerated
-        #: when built) instead — identical observable semantics, the
-        #: scalar path being the spec.  0 forces every tick onto the
-        #: device pipeline (tests, benchmarks).  Default 16 KiB = the
-        #: measured parity point (~128 connections x ~135 B frames,
-        #: CROSSOVER.md): below it the scalar drain wins outright;
-        #: above it the device path is free e2e and adds the stats
-        #: plane + device bodies + offload.
+        #: Small-tick crossover: while the fleet's bytes-per-tick EMA
+        #: sits under this threshold, the ingest runs as a PASS-THROUGH
+        #: — ``feed`` delivers straight through each connection's own
+        #: scalar codec (C-accelerated when built), no accumulator, no
+        #: deferred tick — identical observable semantics, the scalar
+        #: path being the spec, and none of the batching overhead the
+        #: r4 re-sweep measured costing 10-24% when the old design
+        #: still accumulated + tick-drained in this regime.  0 forces
+        #: every tick onto the device pipeline (tests, benchmarks).
+        #: Default 16 KiB = the measured parity point (~128
+        #: connections x ~135 B frames, CROSSOVER.md): below it the
+        #: scalar drain wins outright; above it the device path is
+        #: free e2e and adds the stats plane + device bodies +
+        #: offload.
         self.bypass_bytes = bypass_bytes
         #: Where the tick's XLA program runs.  A tick is latency-bound
         #: (one dispatch + one readback inside the event loop), so
@@ -173,7 +190,35 @@ class FleetIngest:
         self.ticks = 0
         self.ticks_scalar = 0
         self.ticks_warming = 0
+        #: ticks routed to the scalar drain by the fragmentation guard
+        self.ticks_frag = 0
         self.frames_routed = 0
+        #: Upper dispatch guard (CROSSOVER.md: at 1,024 desynchronized
+        #: connections the tick batches fragment to ~16% fill and the
+        #: batched path loses ~37% to the per-socket C drain — the
+        #: measured losing regime the byte threshold cannot see,
+        #: because fragmented mega-fleets still clear 16 KiB/tick).
+        #: An EMA of frames routed per tick, compared against the
+        #: registered fleet size with hysteresis, routes those ticks
+        #: back to the scalar drain.
+        self.frag_guard = frag_guard
+        self._ema_frames: float | None = None
+        self._frag_scalar = False
+        #: Regime flag: in DIRECT mode ``feed`` delivers through the
+        #: connection's own codec immediately — the per-socket scalar
+        #: drain itself, zero accumulate/copy/defer overhead — because
+        #: the dispatch policy says batching does not pay (bytes/tick
+        #: under ``bypass_bytes``, or the fragmentation guard).  In
+        #: BATCH mode bytes accumulate per slot and the tick
+        #: dispatches the device program.  The r4 re-sweep measured
+        #: the old design (accumulate + per-tick scalar drain even
+        #: when bypassing) costing 10-24% vs the native drain — a
+        #: replacement may never regress the drain it replaces, so the
+        #: bypass is now a true pass-through.
+        self._direct = bypass_bytes > 0
+        self._window_bytes = 0
+        self._ema_bytes: float | None = None
+        self._frames_mark = 0
         #: device-body mode: frames whose body needed the scalar
         #: reader (oversized/list-overflow/malformed)
         self.body_fallbacks = 0
@@ -193,9 +238,13 @@ class FleetIngest:
     def register(self, conn: 'ZKConnection') -> None:
         slot = self._slots.setdefault(id(conn), (conn, bytearray()))
         # A partial steady-state frame may have ridden the same TCP
-        # segment as the ConnectResponse: migrate it out of the scalar
-        # decoder so no byte is stranded there.
-        if conn.codec is not None:
+        # segment as the ConnectResponse.  In the BATCH regime it must
+        # migrate out of the scalar decoder into the slot (the tick
+        # scan owns the stream).  In the DIRECT regime the codec keeps
+        # draining the stream itself, so the residue must STAY there —
+        # moving it into a slot nothing drains would strand it and
+        # misframe every later byte.
+        if not self._direct and conn.codec is not None:
             resid = conn.codec.take_pending()
             if resid:
                 slot[1].extend(resid)
@@ -212,8 +261,49 @@ class FleetIngest:
         slot = self._slots.get(id(conn))
         if slot is None:  # raced a teardown; the bytes die with the conn
             return
+        self._window_bytes += len(data)
+        if self._direct:
+            self._schedule()          # bookkeeping tick at cycle end
+            if slot[1]:               # leftover from a regime flip
+                slot[1].extend(data)
+                data = bytes(slot[1])
+                slot[1].clear()
+            self._deliver_direct(conn, data)
+            return
         slot[1].extend(data)
         self._schedule()
+
+    @property
+    def direct(self) -> bool:
+        """True while the ingest is in its pass-through regime: the
+        connection should run the per-socket drain itself and report
+        the counts via :meth:`note_direct` (io/connection.py wires
+        this)."""
+        return self._direct
+
+    def note_direct(self, nbytes: int, nframes: int) -> None:
+        """Bookkeeping for a connection-side direct delivery: feeds
+        the dispatch policy's byte/frame EMAs and schedules the
+        regime-decision tick."""
+        self._window_bytes += nbytes
+        self.frames_routed += nframes
+        self._schedule()
+
+    def _deliver_direct(self, conn: 'ZKConnection',
+                        data: bytes) -> None:
+        """The pass-through drain: decode straight through the
+        connection's codec (which keeps its own partial-frame state
+        across feeds, exactly like the per-socket scalar drain) and
+        emit.  No accumulator, no copy, no deferred tick."""
+        err = None
+        try:
+            pkts = conn.codec.decode(data)
+        except ZKProtocolError as e:
+            pkts = getattr(e, 'packets', [])
+            err = e
+        self.frames_routed += len(pkts)
+        if pkts or err is not None:
+            conn.emit('ingestDeliver', pkts, err)
 
     def _schedule(self) -> None:
         if not self._scheduled:
@@ -444,6 +534,9 @@ class FleetIngest:
                 ('zkstream_ingest_warming_ticks', 'ticks_warming',
                  'ticks deferred to scalar while a shape bucket '
                  'compiled'),
+                ('zkstream_ingest_frag_ticks', 'ticks_frag',
+                 'ticks routed to the scalar drain by the '
+                 'fragmentation guard (fleet large, ticks sparse)'),
                 ('zkstream_ingest_frames_routed', 'frames_routed',
                  'frames delivered through the ingest'),
                 ('zkstream_ingest_body_fallbacks', 'body_fallbacks',
@@ -577,19 +670,111 @@ class FleetIngest:
             off += w
         return st, bd
 
+    def _note_frames(self, n: int) -> None:
+        """Feed the fragmentation EMA with one tick's routed frames
+        (every path: device, bypass, warming, guard)."""
+        self._ema_frames = (float(n) if self._ema_frames is None
+                            else 0.2 * n + 0.8 * self._ema_frames)
+
+    def _frag_guarded(self) -> bool:
+        """The upper dispatch guard: True routes this tick to the
+        scalar drain because the fleet is large but its ticks are
+        fragmented (frames/tick ≪ fleet size — the measured losing
+        regime, CROSSOVER.md).  Hysteresis keeps the router from
+        flapping on tick noise."""
+        if not self.frag_guard:
+            return False
+        n = len(self._slots)
+        if n < self.FRAG_MIN_FLEET or self._ema_frames is None:
+            self._frag_scalar = False
+            return False
+        if self._frag_scalar:
+            if self._ema_frames >= self.FRAG_EXIT * n:
+                self._frag_scalar = False
+        elif self._ema_frames < self.FRAG_ENTER * n:
+            self._frag_scalar = True
+        return self._frag_scalar
+
+    def _want_direct(self) -> bool:
+        """The dispatch policy: should the ingest run as a
+        pass-through drain?  True when the byte volume per tick sits
+        under ``bypass_bytes`` (the measured low-end crossover) or the
+        fragmentation guard says a mega-fleet's ticks stopped being
+        batches (the measured high-end losing regime)."""
+        frag = self._frag_guarded()
+        if frag:
+            return True
+        if not self.bypass_bytes or self._ema_bytes is None:
+            return False
+        if self._direct:
+            # hysteresis: leave the pass-through only once the volume
+            # clearly justifies batching
+            return self._ema_bytes < 1.25 * self.bypass_bytes
+        return self._ema_bytes < self.bypass_bytes
+
+    def _flip_direct(self, active) -> None:
+        """Batch -> pass-through: drain what the slots hold, hand each
+        codec its partial-frame residue, switch."""
+        for conn, buf in active:
+            if id(conn) not in self._slots:
+                continue
+            self._deliver_scalar(conn, buf)
+        for _cid, (conn, buf) in list(self._slots.items()):
+            if buf and conn.codec is not None:
+                conn.codec.restore_pending(bytes(buf))
+                buf.clear()
+        self._direct = True
+
+    def _flip_batch(self) -> None:
+        """Pass-through -> batch: reclaim each codec's partial-frame
+        residue into its slot so the next tick's scan continues it."""
+        self._direct = False
+        for _cid, (conn, buf) in list(self._slots.items()):
+            if conn.codec is not None:
+                resid = conn.codec.take_pending()
+                if resid:
+                    buf[:0] = resid
+
     def _tick(self) -> None:
         self._scheduled = False
+        win = self._window_bytes
+        self._window_bytes = 0
+        if win:
+            self._ema_bytes = (float(win) if self._ema_bytes is None
+                               else 0.2 * win + 0.8 * self._ema_bytes)
+        if self._direct:
+            if not win:
+                return
+            # deliveries already happened inline (connection-side
+            # drain or feed()); this tick is bookkeeping + the regime
+            # decision.  Policy FIRST, then count: ticks_frag must
+            # reflect the updated guard state, not last tick's.
+            self._note_frames(self.frames_routed - self._frames_mark)
+            self._frames_mark = self.frames_routed
+            self.ticks_scalar += 1
+            still_direct = self._want_direct()
+            if self._frag_scalar:
+                self.ticks_frag += 1
+            if not still_direct:
+                self._flip_batch()
+            return
         active = [(conn, buf) for conn, buf in self._slots.values()
                   if buf and conn.is_in_state('connected')]
         if not active:
             return
-        if self.bypass_bytes and sum(
-                len(buf) for _c, buf in active) < self.bypass_bytes:
+        before = self.frames_routed
+        try:
+            self._tick_inner(active)
+        finally:
+            self._note_frames(self.frames_routed - before)
+            self._frames_mark = self.frames_routed
+
+    def _tick_inner(self, active) -> None:
+        if self._want_direct():
             self.ticks_scalar += 1
-            for conn, buf in active:
-                if id(conn) not in self._slots:  # torn down mid-tick
-                    continue
-                self._deliver_scalar(conn, buf)
+            if self._frag_scalar:
+                self.ticks_frag += 1
+            self._flip_direct(active)
             return
 
         B = len(active)

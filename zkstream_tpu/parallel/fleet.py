@@ -51,6 +51,9 @@ class MeshFleetIngest(FleetIngest):
 
     def __init__(self, mesh=None, **kw):
         kw.setdefault('bypass_bytes', 0)
+        # a mesh proxy exists to run the device plane — and the guard's
+        # single-core cost model does not describe a real accelerator
+        kw.setdefault('frag_guard', False)
         super().__init__(**kw)
         self.mesh = mesh if mesh is not None else make_mesh()
         #: fleet-global stats of the LAST device tick (None before the
