@@ -200,7 +200,7 @@ def bench_ext_full(streams) -> float | None:
     return total / dt / (1024 * 1024)
 
 
-def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
+def bench_tensor(buf, lens, pkt0) -> tuple[float, float, float]:
     """Tensor pipeline MiB/s on the default JAX device: the protocol
     tick (header decode + routing) and the **full decode** (tick +
     batched reply-body parse, ops/replies.py — the work of
@@ -225,7 +225,10 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
         wire_pipeline_step,
         wire_pipeline_step_pallas,
     )
-    from zkstream_tpu.ops.replies import parse_reply_bodies
+    from zkstream_tpu.ops.replies import (
+        parse_list_bodies,
+        parse_reply_bodies,
+    )
 
     jb, jl = jnp.asarray(buf), jnp.asarray(lens)
 
@@ -235,16 +238,32 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
                                 max_data=16, max_path=8)
         return st, bd
 
+    def full_deployed(b, l):
+        # the configuration the SHIPPED ingest runs (io/ingest.py
+        # defaults): 256-byte data/path planes plus the speculative
+        # children/ACL list planes — every layout parsed at every
+        # frame, exactly the deployed device-bodies work
+        st = wire_pipeline_step(b, l, max_frames=FRAMES)
+        bd = parse_reply_bodies(b, st.starts, st.sizes,
+                                max_data=256, max_path=256)
+        lb = parse_list_bodies(b, st.starts, st.sizes,
+                               max_children=16, max_name=64,
+                               max_acls=4, max_scheme=16, max_id=64)
+        return st, bd, lb
+
     candidates = [
         ('pallas', lambda b, l: wire_pipeline_step_pallas(
-            b, l, max_frames=FRAMES, block_rows=64)),
+            b, l, max_frames=FRAMES, block_rows=64), REPEATS),
         ('jnp', lambda b, l: wire_pipeline_step(
-            b, l, max_frames=FRAMES)),
-        ('full', full),
+            b, l, max_frames=FRAMES), REPEATS),
+        ('full', full, REPEATS),
+        # deployed widths cost ~20x the toy planes in output bytes;
+        # fewer repeats keep the run inside the time/HBM budget
+        ('full-deployed', full_deployed, max(4, REPEATS // 5)),
     ]
     total = int(lens.sum())
     timed = []
-    for name, fn in candidates:
+    for name, fn, reps in candidates:
         try:
             step = jax.jit(fn)
             out = step(jb, jl)  # compile + warm
@@ -256,28 +275,37 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
             # keep only one tiny output leaf per repeat: it becomes
             # ready when the whole computation does (valid timing),
             # while the big body planes free as dispatches retire —
-            # holding REPEATS full-decode outputs (~0.5 GiB each)
+            # holding REPEATS full-decode outputs (0.5-4 GiB each)
             # exhausts device memory
-            # WireStats (namedtuple) or the full step's (st, bd) pair
+            # WireStats (namedtuple) or a (st, bodies...) tuple
             return (o.n_frames if hasattr(o, 'n_frames')
                     else o[0].n_frames)
         dts = []
         for _ in range(4):
             t0 = time.perf_counter()
-            outs = [leaf(step(jb, jl)) for _ in range(REPEATS)]
+            outs = [leaf(step(jb, jl)) for _ in range(reps)]
             jax.block_until_ready(outs)
-            dts.append((time.perf_counter() - t0) / REPEATS)
+            dts.append((time.perf_counter() - t0) / reps)
         mibs = total / min(dts) / (1024 * 1024)
         timed.append((name, mibs, out))
 
-    tick_best = full_best = 0.0
+    tick_best = full_best = full_deployed_best = 0.0
     for name, mibs, out in timed:
         # correctness gates, after ALL timing (first readback poisons
         # dispatch): a decode mismatch must fail the benchmark, not
         # skip the path
         if name == 'full':
-            _gate_full_decode(out, pkt0)
+            _gate_full_decode(out[:2], pkt0)
             full_best = mibs
+        elif name == 'full-deployed':
+            _gate_full_decode(out[:2], pkt0)
+            # the list planes must also have parsed: a GET_DATA body
+            # is not a children/ACL list, so the speculative parse
+            # flags every frame not-ok — the planes ran, found nothing
+            lb = out[2]
+            assert not bool(np.asarray(lb.ch_ok).any()), \
+                'list plane misparse'
+            full_deployed_best = mibs
         else:
             assert int(np.asarray(out.n_frames).sum()) == B * FRAMES, \
                 f'{name} decode mismatch'
@@ -288,7 +316,8 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float]:
     # zero flagship instead of failing
     assert tick_best > 0, 'no tick path timed'
     assert full_best > 0, 'full-decode path never timed'
-    return tick_best, full_best
+    assert full_deployed_best > 0, 'deployed-width path never timed'
+    return tick_best, full_best, full_deployed_best
 
 
 def _gate_full_decode(out, pkt0) -> None:
@@ -555,7 +584,7 @@ def main() -> None:
     scalar = bench_scalar(streams)
     scalar_full, pkt0 = bench_scalar_full(streams)
     ext_full = bench_ext_full(streams)
-    tick, full = bench_tensor(buf, lens, pkt0)
+    tick, full, full_deployed = bench_tensor(buf, lens, pkt0)
     print(f'# scalar tick baseline: {scalar:.2f} MiB/s over {B} '
           f'streams x {FRAMES} frames (headers only, equal work)',
           file=sys.stderr)
@@ -565,7 +594,15 @@ def main() -> None:
     if ext_full is not None:
         print(f'# C-extension full decode: {ext_full:.2f} MiB/s '
               f'(this framework\'s own native scalar path)',
-              file=sys.stderr)
+          file=sys.stderr)
+    # Roofline note: MiB/s here counts WIRE BYTES PROCESSED per
+    # second, not bytes touched — the header scan gathers ~20 B and
+    # the full decode ~(20 + data + Stat) B of each 104 B frame, so
+    # multi-TiB/s figures are consistent with v5e's ~0.8 TB/s HBM
+    # (the decode reads each wire byte at most once but is PAID per
+    # frame, and most wire bytes are payload it only slices).
+    print('# note: MiB/s = wire bytes processed; see roofline note '
+          'in bench.py main()', file=sys.stderr)
     # protocol-tick metric (headers + routing; the r1/r2 series)
     print(json.dumps({
         'metric': 'wire_decode_throughput',
@@ -573,19 +610,33 @@ def main() -> None:
         'unit': 'MiB/s',
         'vs_baseline': round(tick / scalar, 3),
     }), flush=True)
+    # toy-width full decode (the r3 headline's configuration, kept for
+    # series comparability)
+    print(json.dumps({
+        'metric': 'wire_full_decode_toy_width',
+        'value': round(full, 2),
+        'unit': 'MiB/s',
+        'vs_baseline': round(full / scalar_full, 3),
+        'widths': 'data16/path8',
+    }), flush=True)
     try:
         bench_client_ops()
     except Exception as e:  # secondary metrics never sink the run
         print('# client_ops stage failed: %r' % (e,), file=sys.stderr)
     sys.stderr.flush()
-    # the flagship: FULL decode vs the scalar codec doing the same
-    # complete work (VERDICT r2 item 4) — printed last so the driver
-    # records it as the round's headline
+    # the flagship: FULL decode at the DEPLOYED body configuration
+    # (io/ingest.py defaults: 256-byte data/path planes + children/ACL
+    # list planes) vs the scalar codec doing the same complete work —
+    # printed last so the driver records it as the round's headline
+    # (VERDICT r3 next #2: the headline must be the number the shipped
+    # configuration would produce)
     print(json.dumps({
         'metric': 'wire_full_decode_throughput',
-        'value': round(full, 2),
+        'value': round(full_deployed, 2),
         'unit': 'MiB/s',
-        'vs_baseline': round(full / scalar_full, 3),
+        'vs_baseline': round(full_deployed / scalar_full, 3),
+        'widths': 'data256/path256/ch16x64/acl4',
+        'toy_width_mibs': round(full, 2),
     }), flush=True)
 
 
