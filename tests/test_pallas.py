@@ -196,3 +196,61 @@ def test_target_platform_accepts_string_override():
 
     with jax.default_device('cpu'):
         assert _target_platform() == 'cpu'
+
+
+def _getdata_fleet(rng, B, L, max_data):
+    """Streams of GET_DATA-layout frames: buffer(data) then Stat, with
+    adversarial shapes mixed in (empty data as len -1, truncated Stat,
+    data overrunning the frame, oversized data, non-body frames)."""
+    buf = np.zeros((B, L), np.uint8)
+    lens = np.zeros((B,), np.int32)
+    for i in range(B):
+        s = b''
+        for _ in range(rng.randrange(0, 5)):
+            kind = rng.random()
+            if kind < 0.5:      # well-formed GET_DATA reply
+                dlen = rng.choice([0, 1, 3, max_data - 1, max_data,
+                                   max_data + 5])
+                data = bytes(rng.randrange(256) for _ in range(dlen))
+                body = struct.pack('>i', dlen) + data + bytes(
+                    rng.randrange(256) for _ in range(68))
+            elif kind < 0.6:    # empty buffer as length -1
+                body = struct.pack('>i', -1) + bytes(
+                    rng.randrange(256) for _ in range(68))
+            elif kind < 0.7:    # Stat truncated
+                body = struct.pack('>i', 2) + b'xy' + b'\x01' * 30
+            elif kind < 0.8:    # buffer length overruns the frame
+                body = struct.pack('>i', 4096) + b'zz'
+            else:               # header-only (PING-like)
+                body = b''
+            s += _reply_frame(rng.randrange(1, 1000),
+                              rng.randrange(1 << 40), 0, body)
+        s = s[:L]
+        buf[i, :len(s)] = np.frombuffer(s, np.uint8)
+        lens[i] = len(s)
+    return jnp.asarray(buf), jnp.asarray(lens)
+
+
+@pytest.mark.parametrize('seed', [0, 7])
+def test_pallas_full_decode_matches_jnp(seed):
+    """The fused full-decode kernel's GET_DATA planes equal
+    parse_reply_bodies' field-for-field, including the adversarial
+    shapes (truncated Stat, overrunning buffer, -1 empty)."""
+    from zkstream_tpu.ops.pipeline import wire_full_decode_pallas
+    from zkstream_tpu.ops.replies import parse_reply_bodies
+
+    rng = random.Random(seed)
+    MD = 16
+    buf, lens = _getdata_fleet(rng, 13, 512, MD)
+    st_p, bd_p = wire_full_decode_pallas(
+        buf, lens, max_frames=6, max_data=MD, block_rows=8,
+        interpret=True)
+    st_j = wire_pipeline_step(buf, lens, max_frames=6)
+    _assert_same(st_p, st_j)
+    bd_j = parse_reply_bodies(buf, st_j.starts, st_j.sizes,
+                              max_data=MD, max_path=8)
+    for f in ('data_len', 'data', 'data_mask', 'data_ok'):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(bd_p, f)), np.asarray(getattr(bd_j, f)),
+            err_msg=f'field {f}')
+    _assert_same(bd_p.stat_after_data, bd_j.stat_after_data)

@@ -49,11 +49,114 @@ def fleet(B: int, frames: int, seed: int = 7):
     return v.reshape(B, L), np.full((B,), L, np.int32)
 
 
+def run_full(args) -> None:
+    """Full-decode confirmation rows (VERDICT r3 next #3): the fused
+    Mosaic scan+header+GET_DATA-body kernel vs (a) the equivalent jnp
+    GET_DATA-only decode and (b) the full speculative
+    parse_reply_bodies — at the header kernel's win pocket and its
+    neighbors.  The number decides whether the kernel line lives."""
+    import jax
+    import jax.numpy as jnp
+
+    from zkstream_tpu.ops import replies as R
+    from zkstream_tpu.ops.pipeline import (
+        wire_full_decode_pallas,
+        wire_pipeline_step,
+    )
+
+    MD = 16
+
+    def jnp_getdata(b, l, F):
+        # the same work as the fused kernel, expressed as XLA ops
+        st = wire_pipeline_step(b, l, max_frames=F)
+        frame_ok = (st.starts >= 0) & (st.sizes >= 16)
+        start = jnp.where(frame_ok, st.starts, 0)
+        end = start + jnp.where(frame_ok, st.sizes, 0)
+        p = start + 16
+        dlen, data, mask, ok = R._ustring_at(b, p, frame_ok, end, MD)
+        soff = p + 4 + jnp.maximum(dlen, 0)
+        stat = R.parse_stats(b, soff, ok & (soff + 68 <= end))
+        return st, dlen, data, stat
+
+    def jnp_full(b, l, F):
+        st = wire_pipeline_step(b, l, max_frames=F)
+        bd = R.parse_reply_bodies(b, st.starts, st.sizes,
+                                  max_data=MD, max_path=8)
+        return st, bd
+
+    shapes = [(2048, 64), (8192, 64), (32768, 64)]
+    if args.quick:
+        shapes = [(8192, 64)]
+    gates = []
+    for B, F in shapes:
+        buf, lens = fleet(B, F)
+        jb, jl = jnp.asarray(buf), jnp.asarray(lens)
+        total = int(lens.sum())
+        row = {'B': B, 'frames': F, 'mib': round(total / 2**20, 1),
+               'backend': jax.default_backend(), 'what': 'full'}
+        outs = {}
+        for name, fn in (
+                ('pallas-full',
+                 lambda b, l, F=F: wire_full_decode_pallas(
+                     b, l, max_frames=F, max_data=MD,
+                     block_rows=args.block_rows)),
+                ('jnp-getdata',
+                 lambda b, l, F=F: jnp_getdata(b, l, F)),
+                ('jnp-fullspec',
+                 lambda b, l, F=F: jnp_full(b, l, F))):
+            try:
+                step = jax.jit(fn)
+                out = step(jb, jl)
+                jax.block_until_ready(out)
+            except Exception as e:
+                row[name] = None
+                row[name + '_err'] = repr(e)[:80]
+                continue
+            outs[name] = out
+            dts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                leaves = [step(jb, jl)[0].n_frames
+                          for _ in range(REPEATS)]
+                jax.block_until_ready(leaves)
+                dts.append((time.perf_counter() - t0) / REPEATS)
+            row[name] = round(total / min(dts) / 2**20, 0)
+        if row.get('pallas-full') and row.get('jnp-getdata'):
+            row['ratio_vs_getdata'] = round(
+                row['pallas-full'] / row['jnp-getdata'], 2)
+        if row.get('pallas-full') and row.get('jnp-fullspec'):
+            row['ratio_vs_fullspec'] = round(
+                row['pallas-full'] / row['jnp-fullspec'], 2)
+        print(json.dumps(row), flush=True)
+        gates.append((row, outs, B * F))
+    # correctness gates after all timing (readback poisons dispatch)
+    for row, outs, want in gates:
+        if 'pallas-full' in outs:
+            stp, bdp = outs['pallas-full']
+            assert int(np.asarray(stp.n_frames).sum()) == want, row
+            if 'jnp-getdata' in outs:
+                _stj, dlenj, dataj, statj = outs['jnp-getdata']
+                np.testing.assert_array_equal(
+                    np.asarray(bdp.data_len), np.asarray(dlenj))
+                np.testing.assert_array_equal(
+                    np.asarray(bdp.data), np.asarray(dataj))
+                np.testing.assert_array_equal(
+                    np.asarray(bdp.stat_after_data.mzxid_lo),
+                    np.asarray(statj.mzxid_lo))
+    print('# all full-decode gates passed', file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true')
+    ap.add_argument('--full', action='store_true',
+                    help='run the fused full-decode confirmation rows')
     ap.add_argument('--block-rows', type=int, default=128)
     args = ap.parse_args()
+
+    if args.full:
+        run_full(args)
+        return
 
     import jax
     import jax.numpy as jnp

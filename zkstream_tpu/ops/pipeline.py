@@ -105,6 +105,85 @@ def wire_pipeline_step_pallas(buf, lens, max_frames: int = 32,
                      r['bad'], r['resid'])
 
 
+class GetDataBodies(NamedTuple):
+    """The GET_DATA slice of :class:`..replies.ReplyBodies`, as
+    produced by the fused Pallas full decode — field-for-field the
+    planes ``parse_reply_bodies`` emits for that layout."""
+
+    data_len: jnp.ndarray      # int32 [B, F] raw jute length (0/-1 ok)
+    data: jnp.ndarray          # uint8 [B, F, max_data] zero-padded
+    data_mask: jnp.ndarray     # bool [B, F, max_data]
+    data_ok: jnp.ndarray       # bool [B, F] field extent fit the frame
+    stat_after_data: 'object'  # replies.StatPlanes
+
+
+def wire_full_decode_pallas(buf, lens, max_frames: int = 32,
+                            max_data: int = 16, block_rows: int = 64,
+                            interpret: bool = False):
+    """Fused FULL decode (scan + headers + GET_DATA bodies) in one
+    Mosaic pass (ops/pallas_scan.pallas_wire_full_scan), plus the
+    cheap elementwise unpack XLA fuses for free.  Returns
+    ``(WireStats, GetDataBodies)`` — the Pallas counterpart of
+    ``wire_pipeline_step`` + ``parse_reply_bodies``'s GET_DATA planes
+    (property-tested equivalent in tests/test_pallas.py)."""
+    from .pallas_scan import pallas_wire_full_scan
+    from .replies import StatPlanes
+
+    r = pallas_wire_full_scan(buf, lens, max_frames=max_frames,
+                              block_rows=block_rows, max_data=max_data,
+                              interpret=interpret)
+    valid = r['starts'] >= 0
+    short = valid & (r['sizes'] < 16)
+    headers = {
+        'valid': valid & ~short,
+        'short': short,
+        'xid': r['xid'],
+        'zxid_hi': r['zxid_hi'],
+        'zxid_lo': r['zxid_lo'],
+        'err': r['err'],
+    }
+    st = _assemble(headers, r['starts'], r['sizes'], r['counts'],
+                   r['bad'], r['resid'])
+
+    frame_ok = valid & ~short
+    draw = r['dlen_raw']
+    nb = jnp.maximum(draw, 0)
+    # the _ustring_at extent rule: p+4+n <= end, with p = start+16
+    data_ok = frame_ok & (20 + nb <= r['sizes'])
+    data_len = jnp.where(data_ok, draw, 0)
+    n_ok = jnp.where(data_ok, nb, 0)
+    # BE words -> bytes, masked to the field extent
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.int32)
+    byts = ((r['data_words'][..., None] >> shifts) & 0xFF)
+    B, F = draw.shape
+    byts = byts.reshape(B, F, max_data)
+    pos = jnp.arange(max_data, dtype=jnp.int32)
+    data_mask = pos < n_ok[..., None]
+    data = jnp.where(data_mask, byts, 0).astype(jnp.uint8)
+
+    stat_ok = frame_ok & (20 + nb + 68 <= r['sizes'])
+    sw = r['stat_words']
+    vals = {}
+    k = 0
+    for name, _rel, is_long in (
+            ('czxid', 0, True), ('mzxid', 8, True), ('ctime', 16, True),
+            ('mtime', 24, True), ('version', 32, False),
+            ('cversion', 36, False), ('aversion', 40, False),
+            ('ephemeralOwner', 44, True), ('dataLength', 52, False),
+            ('numChildren', 56, False), ('pzxid', 60, True)):
+        if is_long:
+            vals[name + '_hi'] = sw[:, :, k]
+            vals[name + '_lo'] = sw[:, :, k + 1]
+            k += 2
+        else:
+            vals[name] = sw[:, :, k]
+            k += 1
+    stat = StatPlanes(valid=stat_ok, **vals)
+    return st, GetDataBodies(data_len=data_len, data=data,
+                             data_mask=data_mask, data_ok=data_ok,
+                             stat_after_data=stat)
+
+
 def wire_pipeline_step(buf, lens, max_frames: int = 32) -> WireStats:
     """Decode one tick of B streams.
 
