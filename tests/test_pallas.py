@@ -219,8 +219,11 @@ def _getdata_fleet(rng, B, L, max_data):
                     rng.randrange(256) for _ in range(68))
             elif kind < 0.7:    # Stat truncated
                 body = struct.pack('>i', 2) + b'xy' + b'\x01' * 30
-            elif kind < 0.8:    # buffer length overruns the frame
+            elif kind < 0.75:   # buffer length overruns the frame
                 body = struct.pack('>i', 4096) + b'zz'
+            elif kind < 0.85:   # wire length near INT32_MAX: the
+                # extent check must clamp, not wrap to "valid"
+                body = struct.pack('>i', 0x7FFFFFF4) + b'zz' + b'\x00' * 70
             else:               # header-only (PING-like)
                 body = b''
             s += _reply_frame(rng.randrange(1, 1000),

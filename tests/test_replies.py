@@ -361,3 +361,32 @@ def test_list_truncated_falls_out():
     with pytest.raises(Exception):
         count = r.read_int()
         [r.read_ustring() for _ in range(count)]
+
+
+def test_ustring_extent_check_cannot_wrap_on_huge_lengths():
+    """A wire-controlled jute length near INT32_MAX must not wrap the
+    extent arithmetic and make an overrunning field look valid (r4
+    overflow fix in _ustring_at; the scalar codec would raise for such
+    a field, so the device plane must flag it for the fallback)."""
+    import struct
+
+    import numpy as np
+
+    from zkstream_tpu.ops.pipeline import wire_pipeline_step
+    from zkstream_tpu.ops.replies import parse_reply_bodies
+
+    body = struct.pack('>i', 0x7FFFFFF4) + b'xy' + b'\x00' * 70
+    hdr = struct.pack('>iqi', 5, 9, 0)
+    frame = struct.pack('>i', len(hdr) + len(body)) + hdr + body
+    buf = np.zeros((1, 256), np.uint8)
+    buf[0, :len(frame)] = np.frombuffer(frame, np.uint8)
+    lens = np.asarray([len(frame)], np.int32)
+    st = wire_pipeline_step(jnp.asarray(buf), jnp.asarray(lens),
+                            max_frames=2)
+    bd = parse_reply_bodies(jnp.asarray(buf), st.starts, st.sizes,
+                            max_data=16, max_path=8)
+    assert int(np.asarray(st.n_frames)[0]) == 1
+    assert not bool(np.asarray(bd.data_ok)[0, 0])
+    assert not bool(np.asarray(bd.stat_after_data.valid)[0, 0])
+    assert int(np.asarray(bd.data_len)[0, 0]) == 0
+    assert not np.asarray(bd.data)[0, 0].any()

@@ -46,53 +46,69 @@ _ERR_OFF = 16
 _PAD = 20
 
 
+def _word_plane(buf_ref):
+    """Precompute, once per block, the big-endian int32 word STARTING
+    at every byte position: w32[r, l] = b[l]<<24 | b[l+1]<<16 |
+    b[l+2]<<8 | b[l+3] (the vectorized restatement of
+    lib/jute-buffer.js:102-106).  Static lane rotates are native
+    Mosaic ops; the wrap-around at the row tail only touches positions
+    >= n - 3, which every reader masks off.  Non-overlapping bit
+    planes, so wrapping int32 adds reproduce the signed bit pattern
+    exactly."""
+    _R, Lp = buf_ref.shape
+    b = buf_ref[:].astype(jnp.int32)
+    return ((b << 24) + (pltpu.roll(b, Lp - 1, 1) << 16)
+            + (pltpu.roll(b, Lp - 2, 1) << 8)
+            + pltpu.roll(b, Lp - 3, 1))
+
+
+def _scan_frame(lane, w32, n, cur, bad):
+    """One frame step of the cursor scan, shared by the tick kernel
+    and the fused full-decode kernel so the frame state machine cannot
+    diverge between them.  One subtract per step; each field read is a
+    single-lane equality select + row-sum over the precomputed words —
+    no per-field variable shifts or int multiplies in the loop.
+
+    Returns (start, size, ln, hdr_ok, (xid, zhi, zlo, err), new_cur,
+    new_bad, gather) — ``gather`` reads more 4-byte words at offsets
+    relative to the frame's length prefix."""
+    d = lane - cur
+
+    def gather(off):
+        return jnp.sum(jnp.where(d == off, w32, 0),
+                       axis=1, keepdims=True)
+
+    has_prefix = cur + 4 <= n
+    ln = jnp.where(has_prefix, gather(_LEN_OFF), 0)
+    is_bad = has_prefix & ((ln < 0) | (ln > MAX_PACKET))
+    complete = (has_prefix & ~is_bad & (bad == 0)
+                & (cur + 4 + ln <= n))
+    start = jnp.where(complete, cur + 4, -1)
+    size = jnp.where(complete, ln, 0)
+    # header fields only exist when the body holds the full 16-byte
+    # reply header; shorter complete frames are protocol violations
+    # surfaced via size (pipeline flags them as short)
+    hdr_ok = complete & (ln >= 16)
+    fields = tuple(jnp.where(hdr_ok, gather(off), 0)
+                   for off in (_XID_OFF, _ZHI_OFF, _ZLO_OFF, _ERR_OFF))
+    return (start, size, ln, hdr_ok, fields,
+            jnp.where(complete, cur + 4 + ln, cur),
+            bad | is_bad.astype(jnp.int32), gather)
+
+
 def _kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
             zhi_ref, zlo_ref, err_ref, resid_ref, bad_ref,
             *, max_frames: int):
     """Scan one [R, Lp] uint8 block; emit [F, R] frame/header planes."""
     R, Lp = buf_ref.shape
-
-    b = buf_ref[:].astype(jnp.int32)
     lane = jax.lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
     n = len_ref[:]  # [R, 1]
-
-    # Precompute, once per block, the big-endian int32 word STARTING at
-    # every byte position: w32[r, l] = b[l]<<24 | b[l+1]<<16 | b[l+2]<<8
-    # | b[l+3] (the vectorized restatement of lib/jute-buffer.js:102-106).
-    # Static lane rotates are native Mosaic ops; the wrap-around at the
-    # row tail only touches positions >= n - 3, which every reader below
-    # masks off.  Non-overlapping bit planes, so wrapping int32 adds
-    # reproduce the signed bit pattern exactly.
-    w32 = ((b << 24) + (pltpu.roll(b, Lp - 1, 1) << 16)
-           + (pltpu.roll(b, Lp - 2, 1) << 8) + pltpu.roll(b, Lp - 3, 1))
+    w32 = _word_plane(buf_ref)
 
     def step(j, carry):
         cur, bad = carry  # bad is int32 0/1 (Mosaic-friendly carry)
-        # One subtract per step; each field read is then a single-lane
-        # equality select + row-sum over the precomputed words — no
-        # per-field variable shifts or int multiplies in the loop.
-        d = lane - cur
-
-        def gather(off):
-            return jnp.sum(jnp.where(d == off, w32, 0),
-                           axis=1, keepdims=True)
-
-        has_prefix = cur + 4 <= n
-        ln = jnp.where(has_prefix, gather(_LEN_OFF), 0)
-        is_bad = has_prefix & ((ln < 0) | (ln > MAX_PACKET))
-        complete = (has_prefix & ~is_bad & (bad == 0)
-                    & (cur + 4 + ln <= n))
-        start = jnp.where(complete, cur + 4, -1)
-        size = jnp.where(complete, ln, 0)
-        # header fields only exist when the body holds the full
-        # 16-byte reply header; shorter complete frames are protocol
-        # violations surfaced via size (pipeline flags them as short)
-        hdr_ok = complete & (ln >= 16)
-        xid = jnp.where(hdr_ok, gather(_XID_OFF), 0)
-        zhi = jnp.where(hdr_ok, gather(_ZHI_OFF), 0)
-        zlo = jnp.where(hdr_ok, gather(_ZLO_OFF), 0)
-        err = jnp.where(hdr_ok, gather(_ERR_OFF), 0)
-
+        (start, size, _ln, _hdr_ok, (xid, zhi, zlo, err),
+         cur, bad, _gather) = _scan_frame(lane, w32, n, cur, bad)
         row = pl.ds(j, 1)
         starts_ref[row, :] = start.reshape(1, R)
         sizes_ref[row, :] = size.reshape(1, R)
@@ -100,8 +116,7 @@ def _kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
         zhi_ref[row, :] = zhi.reshape(1, R)
         zlo_ref[row, :] = zlo.reshape(1, R)
         err_ref[row, :] = err.reshape(1, R)
-        return (jnp.where(complete, cur + 4 + ln, cur),
-                bad | is_bad.astype(jnp.int32))
+        return (cur, bad)
 
     cur0 = jnp.zeros((R, 1), jnp.int32)
     bad0 = jnp.zeros((R, 1), jnp.int32)
@@ -129,40 +144,23 @@ def _full_kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
     """
     R, Lp = buf_ref.shape
     DW = max_data // 4
-
-    b = buf_ref[:].astype(jnp.int32)
     lane = jax.lax.broadcasted_iota(jnp.int32, (R, Lp), 1)
     n = len_ref[:]
-
-    w32 = ((b << 24) + (pltpu.roll(b, Lp - 1, 1) << 16)
-           + (pltpu.roll(b, Lp - 2, 1) << 8) + pltpu.roll(b, Lp - 3, 1))
+    w32 = _word_plane(buf_ref)
 
     def step(j, carry):
         cur, bad = carry
-        d = lane - cur
-
-        def gather(off):
-            return jnp.sum(jnp.where(d == off, w32, 0),
-                           axis=1, keepdims=True)
-
-        has_prefix = cur + 4 <= n
-        ln = jnp.where(has_prefix, gather(_LEN_OFF), 0)
-        is_bad = has_prefix & ((ln < 0) | (ln > MAX_PACKET))
-        complete = (has_prefix & ~is_bad & (bad == 0)
-                    & (cur + 4 + ln <= n))
-        start = jnp.where(complete, cur + 4, -1)
-        size = jnp.where(complete, ln, 0)
-        hdr_ok = complete & (ln >= 16)
-        xid = jnp.where(hdr_ok, gather(_XID_OFF), 0)
-        zhi = jnp.where(hdr_ok, gather(_ZHI_OFF), 0)
-        zlo = jnp.where(hdr_ok, gather(_ZLO_OFF), 0)
-        err = jnp.where(hdr_ok, gather(_ERR_OFF), 0)
+        (start, size, ln, hdr_ok, (xid, zhi, zlo, err),
+         new_cur, new_bad, gather) = _scan_frame(lane, w32, n, cur,
+                                                 bad)
 
         # -- GET_DATA body: buffer(len, bytes) at body+4, then Stat --
         # raw jute length field (may be -1 = empty); masked to frames
-        # with a full reply header
+        # with a full reply header.  Clamp before extent arithmetic:
+        # a wire length near INT32_MAX must not wrap the checks below
+        # (mirrors replies._ustring_at).
         draw = jnp.where(hdr_ok, gather(20), 0)
-        nb = jnp.maximum(draw, 0)
+        nb = jnp.minimum(jnp.maximum(draw, 0), MAX_PACKET + 1)
         # data words: bytes cur+24 .. cur+24+max_data as BE words;
         # gather only words the field reaches (byte masking happens in
         # the XLA unpack, where it is elementwise)
@@ -186,8 +184,7 @@ def _full_kernel(buf_ref, len_ref, starts_ref, sizes_ref, xid_ref,
         zlo_ref[row, :] = zlo.reshape(1, R)
         err_ref[row, :] = err.reshape(1, R)
         dlen_ref[row, :] = draw.reshape(1, R)
-        return (jnp.where(complete, cur + 4 + ln, cur),
-                bad | is_bad.astype(jnp.int32))
+        return (new_cur, new_bad)
 
     cur0 = jnp.zeros((R, 1), jnp.int32)
     bad0 = jnp.zeros((R, 1), jnp.int32)

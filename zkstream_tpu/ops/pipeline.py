@@ -74,6 +74,24 @@ def _assemble(headers, starts, sizes, counts, bad, resid) -> WireStats:
     )
 
 
+def _stats_from_scan(r) -> WireStats:
+    """WireStats from a Pallas scan-result dict — the shared tail of
+    both Pallas entry points, so the short-frame/header routing rules
+    cannot diverge between them."""
+    valid = r['starts'] >= 0
+    short = valid & (r['sizes'] < 16)
+    headers = {
+        'valid': valid & ~short,
+        'short': short,
+        'xid': r['xid'],
+        'zxid_hi': r['zxid_hi'],
+        'zxid_lo': r['zxid_lo'],
+        'err': r['err'],
+    }
+    return _assemble(headers, r['starts'], r['sizes'], r['counts'],
+                     r['bad'], r['resid'])
+
+
 def wire_pipeline_step_pallas(buf, lens, max_frames: int = 32,
                               block_rows: int = 64,
                               interpret: bool = False) -> WireStats:
@@ -91,18 +109,7 @@ def wire_pipeline_step_pallas(buf, lens, max_frames: int = 32,
         return wire_pipeline_step(buf, lens, max_frames=max_frames)
     r = pallas_wire_scan(buf, lens, max_frames=max_frames,
                          block_rows=block_rows, interpret=interpret)
-    valid = r['starts'] >= 0
-    short = valid & (r['sizes'] < 16)
-    headers = {
-        'valid': valid & ~short,
-        'short': short,
-        'xid': r['xid'],
-        'zxid_hi': r['zxid_hi'],
-        'zxid_lo': r['zxid_lo'],
-        'err': r['err'],
-    }
-    return _assemble(headers, r['starts'], r['sizes'], r['counts'],
-                     r['bad'], r['resid'])
+    return _stats_from_scan(r)
 
 
 class GetDataBodies(NamedTuple):
@@ -117,6 +124,27 @@ class GetDataBodies(NamedTuple):
     stat_after_data: 'object'  # replies.StatPlanes
 
 
+def getdata_bodies_jnp(buf, st: WireStats,
+                       max_data: int) -> GetDataBodies:
+    """The GET_DATA planes via the jnp body parser — the reference
+    semantics the fused kernel must match, packaged as GetDataBodies.
+    Used as the VMEM-overflow fallback of
+    :func:`wire_full_decode_pallas` and as the equal-work jnp
+    candidate in tools/sweep_pallas.py."""
+    from . import replies as R
+
+    frame_ok = (st.starts >= 0) & (st.sizes >= 16)
+    start = jnp.where(frame_ok, st.starts, 0)
+    end = start + jnp.where(frame_ok, st.sizes, 0)
+    p = start + 16
+    dlen, data, mask, ok = R._ustring_at(buf, p, frame_ok, end,
+                                         max_data)
+    soff = p + 4 + jnp.maximum(dlen, 0)
+    stat = R.parse_stats(buf, soff, ok & (soff + 68 <= end))
+    return GetDataBodies(data_len=dlen, data=data, data_mask=mask,
+                         data_ok=ok, stat_after_data=stat)
+
+
 def wire_full_decode_pallas(buf, lens, max_frames: int = 32,
                             max_data: int = 16, block_rows: int = 64,
                             interpret: bool = False):
@@ -125,29 +153,29 @@ def wire_full_decode_pallas(buf, lens, max_frames: int = 32,
     cheap elementwise unpack XLA fuses for free.  Returns
     ``(WireStats, GetDataBodies)`` — the Pallas counterpart of
     ``wire_pipeline_step`` + ``parse_reply_bodies``'s GET_DATA planes
-    (property-tested equivalent in tests/test_pallas.py)."""
-    from .pallas_scan import pallas_wire_full_scan
-    from .replies import StatPlanes
+    (property-tested equivalent in tests/test_pallas.py).  Shapes
+    whose kernel would exceed the scoped-VMEM limit fall back to the
+    jnp path, like :func:`wire_pipeline_step_pallas`."""
+    from ..protocol.consts import MAX_PACKET
+    from .pallas_scan import fits_vmem_full, pallas_wire_full_scan
+    from .replies import _STAT_FIELDS, StatPlanes
+
+    if not interpret and not fits_vmem_full(
+            buf.shape[0], buf.shape[1], max_frames, block_rows,
+            max_data):
+        st = wire_pipeline_step(buf, lens, max_frames=max_frames)
+        return st, getdata_bodies_jnp(buf, st, max_data)
 
     r = pallas_wire_full_scan(buf, lens, max_frames=max_frames,
                               block_rows=block_rows, max_data=max_data,
                               interpret=interpret)
-    valid = r['starts'] >= 0
-    short = valid & (r['sizes'] < 16)
-    headers = {
-        'valid': valid & ~short,
-        'short': short,
-        'xid': r['xid'],
-        'zxid_hi': r['zxid_hi'],
-        'zxid_lo': r['zxid_lo'],
-        'err': r['err'],
-    }
-    st = _assemble(headers, r['starts'], r['sizes'], r['counts'],
-                   r['bad'], r['resid'])
+    st = _stats_from_scan(r)
 
-    frame_ok = valid & ~short
+    frame_ok = (r['starts'] >= 0) & ~(r['sizes'] < 16)
     draw = r['dlen_raw']
-    nb = jnp.maximum(draw, 0)
+    # same clamp as the kernel and replies._ustring_at: extent math
+    # must not wrap on wire-controlled lengths
+    nb = jnp.minimum(jnp.maximum(draw, 0), MAX_PACKET + 1)
     # the _ustring_at extent rule: p+4+n <= end, with p = start+16
     data_ok = frame_ok & (20 + nb <= r['sizes'])
     data_len = jnp.where(data_ok, draw, 0)
@@ -163,21 +191,16 @@ def wire_full_decode_pallas(buf, lens, max_frames: int = 32,
 
     stat_ok = frame_ok & (20 + nb + 68 <= r['sizes'])
     sw = r['stat_words']
+    # one source of truth for the Stat layout: the kernel writes word
+    # rel//4 (+1 for the low half of 64-bit fields)
     vals = {}
-    k = 0
-    for name, _rel, is_long in (
-            ('czxid', 0, True), ('mzxid', 8, True), ('ctime', 16, True),
-            ('mtime', 24, True), ('version', 32, False),
-            ('cversion', 36, False), ('aversion', 40, False),
-            ('ephemeralOwner', 44, True), ('dataLength', 52, False),
-            ('numChildren', 56, False), ('pzxid', 60, True)):
+    for name, rel, is_long in _STAT_FIELDS:
+        k = rel // 4
         if is_long:
             vals[name + '_hi'] = sw[:, :, k]
             vals[name + '_lo'] = sw[:, :, k + 1]
-            k += 2
         else:
             vals[name] = sw[:, :, k]
-            k += 1
     stat = StatPlanes(valid=stat_ok, **vals)
     return st, GetDataBodies(data_len=data_len, data=data,
                              data_mask=data_mask, data_ok=data_ok,

@@ -35,7 +35,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ..protocol.consts import REPLY_HDR
+from ..protocol.consts import MAX_PACKET, REPLY_HDR
 from .bytesops import be_i32_at, be_i64pair_at
 
 #: Serialized Stat width: 6 longs + 5 ints
@@ -136,7 +136,11 @@ def _ustring_at(buf, off, valid, frame_end, max_len: int):
     where ``ok`` means the field's extent fits inside the frame."""
     off = jnp.where(valid, off, 0)
     raw = jnp.where(valid, be_i32_at(buf, off), 0)
-    n = jnp.maximum(raw, 0)
+    # Clamp BEFORE the extent arithmetic: a wire-controlled length
+    # near INT32_MAX would wrap ``off + 4 + n`` negative and make a
+    # field that overruns the frame look valid.  No legal field can
+    # exceed MAX_PACKET, so the clamp never changes a legal decode.
+    n = jnp.minimum(jnp.maximum(raw, 0), MAX_PACKET + 1)
     ok = valid & (off + 4 + n <= frame_end)
     n = jnp.where(ok, n, 0)
     data, mask = slice_var_bytes(buf, off + 4, n, max_len)
