@@ -568,7 +568,59 @@ def bench_client_ops() -> None:
         }), file=sys.stderr)
 
 
+def _guard_backend(timeout_s: float = 240.0) -> None:
+    """Probe the default JAX backend in a SUBPROCESS before this
+    process touches jax: a wedged tunneled-TPU backend has been
+    observed to block device enumeration for 20+ minutes and then
+    fail, which would kill the run before the flagship metric prints.
+    If the probe cannot enumerate devices, fall back to the host CPU
+    backend so the benchmark completes (the numbers then measure the
+    CPU backend and say so).
+
+    The probe pays one extra backend spin-up on a healthy run — the
+    price of a guaranteed headline when the tunnel is wedged; set
+    ZKSTREAM_BENCH_NO_PROBE=1 to skip it.  No pipes: stderr goes to a
+    temp file so a killed probe (whose tunnel helpers may inherit the
+    descriptors) can never wedge THIS process draining them, and the
+    probe runs in its own session so the whole group is killed on
+    timeout."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    if os.environ.get('ZKSTREAM_BENCH_NO_PROBE') == '1':
+        return
+    reason = None
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
+            [sys.executable, '-c', 'import jax; jax.devices()'],
+            stdout=subprocess.DEVNULL, stderr=errf,
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            reason = 'probe timed out after %.0fs' % timeout_s
+        else:
+            if rc == 0:
+                return
+            errf.seek(0)
+            tail = errf.read().decode(errors='replace').strip()
+            reason = 'probe failed: %s' % (
+                tail.splitlines()[-1:] or ['?'])[0]
+    print('# default JAX backend unavailable (%s); falling back to '
+          'the host CPU backend' % (reason,), file=sys.stderr)
+    from zkstream_tpu.utils.platform import force_cpu
+    force_cpu(n_devices=1)
+
+
 def main() -> None:
+    _guard_backend()
     # Initialize the host CPU backend FIRST: the fleet ingest's
     # latency-aware placement wants it, and creating a second PJRT
     # client after heavy accelerator use has been observed to block on
@@ -604,11 +656,13 @@ def main() -> None:
     print('# note: MiB/s = wire bytes processed; see roofline note '
           'in bench.py main()', file=sys.stderr)
     # protocol-tick metric (headers + routing; the r1/r2 series)
+    backend = jax.default_backend()
     print(json.dumps({
         'metric': 'wire_decode_throughput',
         'value': round(tick, 2),
         'unit': 'MiB/s',
         'vs_baseline': round(tick / scalar, 3),
+        'backend': backend,
     }), flush=True)
     # toy-width full decode (the r3 headline's configuration, kept for
     # series comparability)
@@ -618,6 +672,7 @@ def main() -> None:
         'unit': 'MiB/s',
         'vs_baseline': round(full / scalar_full, 3),
         'widths': 'data16/path8',
+        'backend': backend,
     }), flush=True)
     try:
         bench_client_ops()
@@ -637,6 +692,7 @@ def main() -> None:
         'vs_baseline': round(full_deployed / scalar_full, 3),
         'widths': 'data256/path256/ch16x64/acl4',
         'toy_width_mibs': round(full, 2),
+        'backend': backend,
     }), flush=True)
 
 
