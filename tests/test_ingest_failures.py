@@ -408,3 +408,57 @@ async def test_fragmentation_guard_enters_and_exits():
     assert not ing._frag_scalar
     assert ing.ticks_frag <= frag_at + 3
     assert ing.ticks > device_before     # device path resumed
+
+
+async def test_direct_and_batch_regimes_deliver_identically():
+    """Property: the SAME randomized feed pattern through a forced
+    pass-through ingest and a forced batch ingest delivers identical
+    packet sequences per connection — the regime machine is an
+    execution-layout choice, never a semantics change."""
+    import random
+
+    rng = random.Random(2024)
+
+    def traffic():
+        out = []
+        for i in range(40):
+            kind = rng.random()
+            if kind < 0.6:
+                out.append(('frame', reply_frame(-2)))
+            elif kind < 0.8:
+                w = reply_frame(-1, 'NOTIFICATION', zxid=100 + i,
+                                type='DATA_CHANGED',
+                                state='SYNC_CONNECTED', path='/p%d' % i)
+                out.append(('frame', w))
+            else:
+                out.append(('split', reply_frame(-2)))
+        return out
+
+    plan = traffic()
+
+    async def run(ing):
+        conns = [FakeConn() for _ in range(3)]
+        for c in conns:
+            ing.register(c)
+        for j, (kind, wire) in enumerate(plan):
+            c = conns[j % 3]
+            if kind == 'split':      # byte-at-a-time partial feeds
+                for off in range(0, len(wire), 5):
+                    ing.feed(c, wire[off:off + 5])
+                    await asyncio.sleep(0)
+            else:
+                ing.feed(c, wire)
+            if j % 4 == 0:
+                await drain()
+        for _ in range(6):
+            await drain()
+        for c in conns:          # no regime may surface an error
+            assert all(e is None for _pkts, e in c.delivered)
+        return [[(p['opcode'], p.get('path'), p['zxid'])
+                 for pkts, _e in c.delivered for p in pkts]
+                for c in conns]
+
+    direct = await run(mk_ingest(bypass_bytes=1 << 30))  # always direct
+    batch = await run(mk_ingest(bypass_bytes=0))         # always batch
+    assert direct == batch
+    assert sum(len(x) for x in direct) == len(plan)

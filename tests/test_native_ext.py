@@ -265,6 +265,24 @@ def server_decode_both(wire: bytes):
     return py, py_res, ext, ext_res
 
 
+def client_decode_both(wire: bytes, xid_map: dict):
+    """Client-direction twin of :func:`server_decode_both`: both
+    decoders over the same reply bytes with the same xid map."""
+    out = []
+    for use_native in (False, True):
+        c = PacketCodec(use_native=use_native)
+        c.handshaking = False
+        c.xid_map = dict(xid_map)
+        try:
+            res = ('ok', c.decode(wire), None)
+        except ZKProtocolError as e:
+            res = ('err', getattr(e, 'packets', []), e.code)
+        out.append((c, res))
+    (py, py_res), (ext, ext_res) = out
+    assert ext._ext is not None, 'extension did not engage'
+    return py, py_res, ext, ext_res
+
+
 def test_server_direction_all_opcodes_equivalent():
     """The server-side request decoder (C) equals the Python spec over
     every request opcode, including SET_WATCHES' three path lists and
@@ -485,3 +503,37 @@ def test_differential_fuzz_request_decode():
         py, (k1, p1, c1), ext, (k2, p2, c2) = server_decode_both(wire)
         assert (k1, c1) == (k2, c2), (trial, wire.hex(), c1, c2)
         assert p1 == p2, (trial, wire.hex(), p1, p2)
+
+
+def test_differential_fuzz_response_decode():
+    """Response-direction twin of the request fuzz: random,
+    half-structured, and corrupted-suffix reply frames through both
+    decoders, with random xid maps — identical packets, pre-error
+    retention, error codes, and xid-map state required."""
+    rng = random.Random(0xBEEF)
+    for trial in range(600):
+        xids = [rng.randrange(1, 64) for _ in range(4)]
+        replies = {x: rng.choice(list(records._RESP_READERS) +
+                                 ['SYNC', 'DELETE']) for x in xids}
+        kind = rng.random()
+        if kind < 0.35:
+            body = rng.randbytes(rng.randrange(0, 48))
+        elif kind < 0.8:
+            body = struct.pack(
+                '>iqi', rng.choice(xids + [-1, -2, -4, -8, 999]),
+                rng.randrange(-(1 << 40), 1 << 40),
+                rng.choice([0, -101, -4, 7, -999]))
+            body += rng.randbytes(rng.randrange(0, 40))
+        else:
+            base = encode_replies([
+                {'xid': xids[0], 'zxid': 5, 'err': 'OK',
+                 'opcode': 'GET_DATA', 'data': b'abc', 'stat': STAT}])[4:]
+            cut = rng.randrange(0, len(base) + 1)
+            body = base[:cut] + rng.randbytes(rng.randrange(0, 12))
+            replies[xids[0]] = 'GET_DATA'
+        wire = struct.pack('>i', len(body)) + body
+        py, (k1, p1, c1), ext, (k2, p2, c2) = client_decode_both(
+            wire, replies)
+        assert (k1, c1) == (k2, c2), (trial, wire.hex(), c1, c2)
+        assert p1 == p2, (trial, wire.hex())
+        assert py.xid_map == ext.xid_map, (trial, wire.hex())
