@@ -250,11 +250,13 @@ def encode_requests(requests) -> bytes:
     return b''.join(enc.encode(dict(p)) for p in requests)
 
 
-def server_decode_both(wire: bytes):
+def _decode_both_with(mk_codec, wire: bytes):
+    """Run both implementations built by ``mk_codec(use_native)`` over
+    ``wire``; shared by the server- and client-direction harnesses so
+    the ('ok'/'err', packets, code) contract lives in one place."""
     out = []
     for use_native in (False, True):
-        c = PacketCodec(server=True, use_native=use_native)
-        c.handshaking = False
+        c = mk_codec(use_native)
         try:
             res = ('ok', c.decode(wire), None)
         except ZKProtocolError as e:
@@ -263,24 +265,25 @@ def server_decode_both(wire: bytes):
     (py, py_res), (ext, ext_res) = out
     assert ext._ext is not None, 'extension did not engage'
     return py, py_res, ext, ext_res
+
+
+def server_decode_both(wire: bytes):
+    def mk(use_native):
+        c = PacketCodec(server=True, use_native=use_native)
+        c.handshaking = False
+        return c
+    return _decode_both_with(mk, wire)
 
 
 def client_decode_both(wire: bytes, xid_map: dict):
     """Client-direction twin of :func:`server_decode_both`: both
     decoders over the same reply bytes with the same xid map."""
-    out = []
-    for use_native in (False, True):
+    def mk(use_native):
         c = PacketCodec(use_native=use_native)
         c.handshaking = False
         c.xid_map = dict(xid_map)
-        try:
-            res = ('ok', c.decode(wire), None)
-        except ZKProtocolError as e:
-            res = ('err', getattr(e, 'packets', []), e.code)
-        out.append((c, res))
-    (py, py_res), (ext, ext_res) = out
-    assert ext._ext is not None, 'extension did not engage'
-    return py, py_res, ext, ext_res
+        return c
+    return _decode_both_with(mk, wire)
 
 
 def test_server_direction_all_opcodes_equivalent():
