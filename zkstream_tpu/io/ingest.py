@@ -554,7 +554,14 @@ class FleetIngest:
         prewarms for several buckets drain through the single warm
         worker one at a time (total ~= sum of compiles, not max) — the
         same serialization that keeps background warms from
-        oversubscribing a host mid-service."""
+        oversubscribing a host mid-service.
+
+        On an UNREACHABLE accelerator backend (e.g. a dead tunnel)
+        the XLA compile itself can block indefinitely; traffic keeps
+        flowing through the scalar drain regardless (no tick ever
+        waits on a compile), but this await would wait with it —
+        callers that must bound startup should wrap it in
+        ``asyncio.wait_for``."""
         key = self._bucket(n_streams, nbytes or self.min_len)
         if self._exec.get(key, _MISSING) is not _MISSING:
             return
