@@ -19,6 +19,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# The tick latency being diagnosed is the host CPU backend's (the only
+# placement a tunneled-TPU environment can use, CROSSOVER.md), and
+# pinning the platform before jax initializes keeps the tool working
+# when the tunnel is down — backend enumeration would otherwise touch
+# the dead accelerator plugin and hang.
+from zkstream_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_devices=1)
+
 TICKS: list[dict] = []
 
 
@@ -59,8 +68,13 @@ async def run(n_clients: int, n_ops: int) -> None:
     from zkstream_tpu.server import ZKServer
 
     instrument(FleetIngest)
+    # placement='host': the tick latency being diagnosed is the host
+    # CPU backend's (the only placement a tunneled-TPU environment can
+    # use, CROSSOVER.md), and it keeps the tool working when the
+    # tunnel is down — the default 'auto' probe would touch the dead
+    # accelerator backend and hang
     ingest = FleetIngest(body_mode='host', max_frames=16,
-                         bypass_bytes=0)
+                         bypass_bytes=0, placement='host')
     srv = await ZKServer().start()
     clients = [Client(address='127.0.0.1', port=srv.port,
                       session_timeout=30000, ingest=ingest)
