@@ -306,3 +306,33 @@ async def test_sequential_counter_shared_across_servers(ensemble):
     assert p2 == '/seq-0000000001'
     await c1.close()
     await c2.close()
+
+
+async def test_sync_through_batched_ingest(ensemble):
+    """Cross-feature composition: the follower-lag/sync semantics hold
+    when the clients' receive path runs through the batched device
+    ingest — the replication model and the decode plane compose."""
+    from zkstream_tpu.io.ingest import FleetIngest
+
+    ensemble.set_lag(1, None)
+    ing = FleetIngest(body_mode='host', max_frames=8, bypass_bytes=0,
+                      warm='block', min_len=1024)
+    await ing.prewarm(2)
+    c1 = make_client(ensemble, pin=0, ingest=ing)
+    c2 = make_client(ensemble, pin=1, ingest=ing)
+    await c1.wait_connected(timeout=5)
+    await c2.wait_connected(timeout=5)
+
+    await c1.create('/il', b'old')
+    await c2.sync('/il')
+    data, _ = await c2.get('/il')
+    assert data == b'old'
+    await c1.set('/il', b'new')
+    data, _ = await c2.get('/il')      # held follower: stale
+    assert data == b'old'
+    await c2.sync('/il')
+    data, stat = await c2.get('/il')   # synced: fresh
+    assert data == b'new' and stat.version == 1
+    assert ing.ticks > 0               # the device plane carried it
+    await c1.close()
+    await c2.close()
