@@ -34,6 +34,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# Pin the CPU platform before jax initializes: every e2e cell here is
+# host-core-bound by design (placement='auto' picks the host backend
+# behind a tunneled accelerator anyway, CROSSOVER.md), and backend
+# enumeration with a wedged tunnel hangs — a dead accelerator must not
+# wedge a host-path sweep.
+from zkstream_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_devices=1)
+
 GETS_TOTAL = 2048        # total get ops per cell, split over the fleet
 STORMS = 5               # fan-out storms per cell
 MAX_FRAMES = 16          # ingest per-stream frame bound (--max-frames)
