@@ -305,8 +305,9 @@ def test_list_bodies_device_matches_scalar(data):
                 for k in range(cnt):
                     want = pkt['acl'][k]
                     assert int(lb.acl_perms[i, f, k]) == int(want.perms)
-                    sl = max(int(lb.acl_scheme_len[i, f, k]), 0)
-                    il = max(int(lb.acl_id_len[i, f, k]), 0)
+                    sl = int(lb.acl_scheme_len[i, f, k])
+                    il = int(lb.acl_id_len[i, f, k])
+                    assert 0 <= sl <= SS and 0 <= il <= SI
                     assert bytes(np.asarray(
                         lb.acl_scheme)[i, f, k, :sl]).decode() \
                         == want.id.scheme
@@ -321,7 +322,8 @@ def test_list_bodies_device_matches_scalar(data):
                 cnt = int(lb.ch_count[i, f])
                 assert cnt == len(pkt['children'])
                 for k in range(cnt):
-                    n = max(int(lb.ch_len[i, f, k]), 0)
+                    n = int(lb.ch_len[i, f, k])
+                    assert 0 <= n <= S
                     assert bytes(np.asarray(
                         lb.ch_bytes)[i, f, k, :n]).decode() \
                         == pkt['children'][k]

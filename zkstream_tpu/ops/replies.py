@@ -242,11 +242,14 @@ class ListBodies(NamedTuple):
     ``ch_ok`` / ``acl_ok`` mean the whole list fits the bounds AND lies
     within the frame; a False slot must take the scalar fallback (which
     either parses the oversized list or raises exactly the scalar
-    error).  Element lengths are the raw jute values (negative decodes
-    as empty, lib/jute-buffer.js:99-100)."""
+    error).  Element length planes hold the **decoded** byte count —
+    clamped to >= 0, because a negative jute length decodes as an empty
+    string (lib/jute-buffer.js:99-100) — so wherever the ok mask is
+    set, every length lies in [0, max_*]; consumers slice with it
+    directly."""
 
     ch_count: jnp.ndarray        # int32 [B, F]
-    ch_len: jnp.ndarray          # int32 [B, F, K] raw jute lengths
+    ch_len: jnp.ndarray          # int32 [B, F, K] decoded lengths >= 0
     ch_bytes: jnp.ndarray        # uint8 [B, F, K, S]
     ch_ok: jnp.ndarray           # bool [B, F]
     stat_after_children: StatPlanes   # GET_CHILDREN2 trailing Stat
@@ -265,14 +268,17 @@ def _scan_ustring(buf, cur, active, frame_end, max_len: int):
     (int32 len, bytes) at ``cur`` where ``active``; an element is ok
     when its extent fits the frame AND its length fits ``max_len``
     (truncation is not an option for list elements — the whole frame
-    falls back instead).  Returns (raw_len, bytes, ok, next_cur)."""
+    falls back instead).  Returns (len, bytes, ok, next_cur) where
+    ``len`` is the DECODED byte count — a negative jute length decodes
+    as empty (lib/jute-buffer.js:99-100), so the plane reports 0, not
+    the raw wire value."""
     at = jnp.where(active, cur, 0)
     raw = jnp.where(active, be_i32_at(buf, at), 0)
     n = jnp.maximum(raw, 0)
     ok = active & (cur + 4 + n <= frame_end) & (n <= max_len)
     data, _mask = slice_var_bytes(buf, cur + 4, jnp.where(ok, n, 0),
                                   max_len)
-    return (jnp.where(ok, raw, 0), data, ok,
+    return (jnp.where(ok, n, 0), data, ok,
             jnp.where(ok, cur + 4 + n, cur))
 
 
