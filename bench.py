@@ -5,7 +5,10 @@ benchmarks/ dir, README is API docs only), so the measurable baseline
 is defined here: decode a fleet of framed ZooKeeper reply streams —
 frame slicing + reply-header parse + xid routing + max-zxid session
 reduction, exactly the per-connection hot path of
-lib/zk-streams.js:39-99 / lib/connection-fsm.js:213-229 — and compare
+lib/zk-streams.js:39-99 / lib/connection-fsm.js:213-229, over a
+mixed-opcode corpus (256 B GET_DATA replies, genuine children/ACL
+lists, notifications, error and ping replies — deployed-shaped
+traffic, not toy frames) — and compare
 
   baseline:  the scalar bytes-loop codec (zkstream_tpu.protocol), the
              same implementation idiom as the reference's JavaScript
@@ -27,70 +30,177 @@ import time
 
 import numpy as np
 
-B = 32768        # streams (connections) per tick
+B = 16384        # streams (connections) per tick
 FRAMES = 64      # frames per stream
-BODY = 84        # body bytes per frame -> 104-byte frames
 REPEATS = 30     # dispatches per timing round (x4 rounds, min taken)
 
+# -- mixed-opcode corpus widths (VERDICT r4 next #2: the flagship must
+# decode deployed-SHAPED traffic, not 12-byte toy frames) --
+DATA_LEN = 256       # GET_DATA payload bytes (fills the 256 B plane)
+CH2_N, CH2_NAME = 8, 12      # GET_CHILDREN2: children x name bytes
+CH_N, CH_NAME = 6, 10        # GET_CHILDREN (no Stat)
+ACL_N, ACL_SCHEME, ACL_ID = 2, 6, 24
+NOTIF_PATH = 20
 
-DATA_LEN = 12    # GET_DATA payload bytes per reply
+# -- deployed decode-plane widths (io/ingest.py defaults).  One source
+# of truth: the full_deployed program, the differential gate, and the
+# scalar agreement walks must all use the SAME bounds, or the gates
+# would validate against different limits than the timed program --
+DEP_DATA, DEP_PATH = 256, 256
+DEP_CHILDREN, DEP_NAME = 16, 64
+DEP_ACLS, DEP_SCHEME, DEP_ID = 4, 16, 64
+
+#: Per-16-frame opcode pattern, repeated FRAMES/16 times per stream:
+#: GET_DATA-dominant (the hot op), with real children/ACL lists, watch
+#: notifications, error replies, and ping replies interleaved so every
+#: plane of the deployed decode carries live traffic.
+_SLOT_PATTERN = (
+    'data', 'data', 'children2', 'data', 'notif', 'data', 'acl',
+    'data', 'data', 'children', 'data_err', 'data', 'data',
+    'children2', 'ping', 'data')
+
+_BODY_LEN = {
+    'data': 16 + 4 + DATA_LEN + 68,
+    'data_err': 16,                       # error reply: header only
+    'children2': 16 + 4 + CH2_N * (4 + CH2_NAME) + 68,
+    'children': 16 + 4 + CH_N * (4 + CH_NAME),
+    'acl': 16 + 4 + ACL_N * (4 + 4 + ACL_SCHEME + 4 + ACL_ID) + 68,
+    'notif': 16 + 4 + 4 + 4 + NOTIF_PATH,
+    'ping': 16,
+}
+
+_OPCODE = {
+    'data': 'GET_DATA', 'data_err': 'GET_DATA',
+    'children2': 'GET_CHILDREN2', 'children': 'GET_CHILDREN',
+    'acl': 'GET_ACL', 'notif': 'NOTIFICATION', 'ping': 'PING',
+}
+
+
+def _slot_schedule():
+    """The corpus's static frame layout: every stream carries the same
+    (opcode, width) sequence at the same byte offsets — contents are
+    random per stream — so the builder stays vectorized and the gates
+    know each frame's ground-truth opcode without re-parsing.  Returns
+    (slots, stream_len); each slot is a dict with ``kind``, ``opcode``,
+    ``off`` (frame start), ``body_len`` and ``xid_index`` (None for the
+    special-xid notification/ping frames)."""
+    assert FRAMES % len(_SLOT_PATTERN) == 0
+    kinds = _SLOT_PATTERN * (FRAMES // len(_SLOT_PATTERN))
+    slots, off, xi = [], 0, 0
+    for kind in kinds:
+        bl = _BODY_LEN[kind]
+        has_xid = kind not in ('notif', 'ping')
+        slots.append({'kind': kind, 'opcode': _OPCODE[kind],
+                      'off': off, 'body_len': bl,
+                      'xid_index': xi if has_xid else None})
+        if has_xid:
+            xi += 1
+        off += 4 + bl
+    return slots, off
 
 
 def _fleet():
     """Vectorized fleet builder: [B, L] framed streams of **valid
-    GET_DATA replies** — reply header, then buffer(data) + Stat
-    (reference layout: lib/zk-buffer.js:281-331,353-357,428-442) —
-    so the full-decode benchmark decodes real bodies, not noise
-    (32768 x 64 x 104 B = 208 MiB at the default shape).
+    mixed-opcode replies** — reply headers then per-opcode bodies
+    (reference layouts: lib/zk-buffer.js:275-370,428-442) per the
+    :func:`_slot_schedule` pattern, so the full-decode benchmark
+    decodes deployed-shaped traffic: 256 B GET_DATA payloads, genuine
+    children and ACL lists, notifications, error and ping replies
+    (16384 x ~15.4 KiB = ~247 MiB per tick).
 
     A shape sweep on the tunneled v5e showed the step time pinned at
     ~90-140 us from 13 MiB up to 208 MiB per tick — the
     remote-dispatch latency floor — so the tick must be fleet-proxy
-    sized for the device to be doing meaningful work per dispatch; at
-    208 MiB/tick the decode sustains ~1.7-2.9 TiB/s vs ~0.1 TiB/s at
-    the round-1 2048x64 shape."""
+    sized for the device to be doing meaningful work per dispatch."""
     rng = np.random.RandomState(42)
-    frame_len = 4 + 16 + BODY
-    L = FRAMES * frame_len
-    v = np.zeros((B, FRAMES, frame_len), np.uint8)
+    slots, L = _slot_schedule()
+    v = np.zeros((B, L), np.uint8)
 
     def be(field, width, out):
         shifts = np.arange(8 * (width - 1), -1, -8, dtype=np.int64)
         out[...] = ((field[..., None] >> shifts) & 0xFF).astype(np.uint8)
 
     def ri(lo, hi):
-        return rng.randint(lo, hi, (B, FRAMES)).astype(np.int64)
+        return rng.randint(lo, hi, (B,)).astype(np.int64)
 
-    zxid = ri(1, 1 << 40)
-    be(np.full((B, FRAMES), 16 + BODY, np.int64), 4, v[:, :, 0:4])
+    def full(x):
+        return np.full((B,), x, np.int64)
+
+    def ascii_bytes(n):
+        return rng.randint(97, 123, (B, n), dtype=np.uint8)  # a-z
+
+    def write_stat(off, mzxid, data_len=0, num_children=0):
+        be(ri(1, 1 << 40), 8, v[:, off:off + 8])          # czxid
+        be(mzxid, 8, v[:, off + 8:off + 16])              # mzxid
+        be(ri(1, 1 << 41), 8, v[:, off + 16:off + 24])    # ctime
+        be(ri(1, 1 << 41), 8, v[:, off + 24:off + 32])    # mtime
+        be(ri(0, 1 << 10), 4, v[:, off + 32:off + 36])    # version
+        be(ri(0, 1 << 10), 4, v[:, off + 36:off + 40])    # cversion
+        be(ri(0, 1 << 10), 4, v[:, off + 40:off + 44])    # aversion
+        # ephemeralOwner stays 0
+        be(full(data_len), 4, v[:, off + 52:off + 56])    # dataLength
+        be(full(num_children), 4, v[:, off + 56:off + 60])
+        be(ri(1, 1 << 40), 8, v[:, off + 60:off + 68])    # pzxid
+
     # xids: sequential per stream from a random base, like the
     # connection FSM's allocator — a reply xid is unique in flight
     # (duplicates would poison the pop-on-reply xid map)
-    xid = (rng.randint(1, 1 << 19, (B, 1)).astype(np.int64)
-           + np.arange(FRAMES, dtype=np.int64))
-    be(xid, 4, v[:, :, 4:8])
-    be(zxid, 8, v[:, :, 8:16])                   # zxid (err stays 0)
-    # GET_DATA body: buffer(len, data) then the 68-byte Stat
-    be(np.full((B, FRAMES), DATA_LEN, np.int64), 4, v[:, :, 20:24])
-    v[:, :, 24:24 + DATA_LEN] = rng.randint(
-        0, 256, (B, FRAMES, DATA_LEN), dtype=np.uint8)
-    s = 24 + DATA_LEN                            # Stat start
-    be(ri(1, 1 << 40), 8, v[:, :, s:s + 8])          # czxid
-    be(zxid, 8, v[:, :, s + 8:s + 16])               # mzxid
-    be(ri(1, 1 << 41), 8, v[:, :, s + 16:s + 24])    # ctime
-    be(ri(1, 1 << 41), 8, v[:, :, s + 24:s + 32])    # mtime
-    be(ri(0, 1 << 10), 4, v[:, :, s + 32:s + 36])    # version
-    be(ri(0, 1 << 10), 4, v[:, :, s + 36:s + 40])    # cversion
-    be(ri(0, 1 << 10), 4, v[:, :, s + 40:s + 44])    # aversion
-    # ephemeralOwner stays 0
-    be(np.full((B, FRAMES), DATA_LEN, np.int64), 4,
-       v[:, :, s + 52:s + 56])                       # dataLength
-    # numChildren stays 0
-    be(ri(1, 1 << 40), 8, v[:, :, s + 60:s + 68])    # pzxid
-    buf = v.reshape(B, L)
+    xbase = rng.randint(1, 1 << 19, (B,)).astype(np.int64)
+
+    for s in slots:
+        o, kind = s['off'], s['kind']
+        be(full(s['body_len']), 4, v[:, o:o + 4])
+        if kind == 'notif':
+            xid, zxid, err = full(-1), full(-1), 0
+        elif kind == 'ping':
+            xid, zxid, err = full(-2), ri(1, 1 << 40), 0
+        else:
+            xid, zxid = xbase + s['xid_index'], ri(1, 1 << 40)
+            err = -101 if kind == 'data_err' else 0  # NO_NODE
+        be(xid, 4, v[:, o + 4:o + 8])
+        be(zxid, 8, v[:, o + 8:o + 16])
+        be(full(err), 4, v[:, o + 16:o + 20])
+        p = o + 20                                  # payload start
+        if kind == 'data':
+            be(full(DATA_LEN), 4, v[:, p:p + 4])
+            v[:, p + 4:p + 4 + DATA_LEN] = rng.randint(
+                0, 256, (B, DATA_LEN), dtype=np.uint8)
+            write_stat(p + 4 + DATA_LEN, zxid, data_len=DATA_LEN)
+        elif kind in ('children2', 'children'):
+            n, w = ((CH2_N, CH2_NAME) if kind == 'children2'
+                    else (CH_N, CH_NAME))
+            be(full(n), 4, v[:, p:p + 4])
+            c = p + 4
+            for _k in range(n):
+                be(full(w), 4, v[:, c:c + 4])
+                v[:, c + 4:c + 4 + w] = ascii_bytes(w)
+                c += 4 + w
+            if kind == 'children2':
+                write_stat(c, zxid, num_children=n)
+        elif kind == 'acl':
+            be(full(ACL_N), 4, v[:, p:p + 4])
+            c = p + 4
+            for _k in range(ACL_N):
+                be(full(0x1F), 4, v[:, c:c + 4])    # perms: ALL
+                be(full(ACL_SCHEME), 4, v[:, c + 4:c + 8])
+                v[:, c + 8:c + 8 + ACL_SCHEME] = ascii_bytes(ACL_SCHEME)
+                c += 8 + ACL_SCHEME
+                be(full(ACL_ID), 4, v[:, c:c + 4])
+                v[:, c + 4:c + 4 + ACL_ID] = ascii_bytes(ACL_ID)
+                c += 4 + ACL_ID
+            write_stat(c, zxid)
+        elif kind == 'notif':
+            be(ri(1, 5), 4, v[:, p:p + 4])          # type: valid enum
+            be(full(3), 4, v[:, p + 4:p + 8])       # SYNC_CONNECTED
+            be(full(NOTIF_PATH), 4, v[:, p + 8:p + 12])
+            v[:, p + 12] = ord('/')
+            v[:, p + 13:p + 12 + NOTIF_PATH] = ascii_bytes(
+                NOTIF_PATH - 1)
+        # 'ping' / 'data_err': header-only bodies, nothing more
+    buf = v
     lens = np.full((B,), L, np.int32)
     streams = [buf[i].tobytes() for i in range(B)]
-    return buf, lens, streams
+    return buf, lens, streams, slots
 
 
 def bench_scalar(streams) -> float:
@@ -133,50 +243,57 @@ SCALAR_FULL_STREAMS = 1024   # subset for the interpreted full decode
                              # (throughput is per-byte; ~65k frames is
                              # plenty and keeps the bench under budget)
 
+CHECK_STREAMS = 64           # subset whose scalar packets are retained
+                             # frame-for-frame for the differential
+                             # device-decode gates
 
-def _xid_maps(sub):
+
+def _xid_maps(sub, slots):
     """Per-stream xid -> opcode maps, as each connection's send side
-    would have recorded them (lib/zk-streams.js:145)."""
+    would have recorded them (lib/zk-streams.js:145).  Notification and
+    ping frames carry reserved xids and never enter the map."""
     hdr_xid = struct.Struct('>i')
     maps = []
-    frame_len = 4 + 16 + BODY
     for s in sub:
         m = {}
-        for off in range(0, len(s), frame_len):
-            (xid,) = hdr_xid.unpack_from(s, off + 4)
-            m[xid] = 'GET_DATA'
+        for sl in slots:
+            if sl['xid_index'] is None:
+                continue
+            (xid,) = hdr_xid.unpack_from(s, sl['off'] + 4)
+            m[xid] = sl['opcode']
         maps.append(m)
     return maps
 
 
-def bench_scalar_full(streams):
+def bench_scalar_full(streams, slots):
     """Scalar **full decode** baseline, MiB/s: framing + reply header +
-    opcode-dispatched body parse into packet dicts (data bytes + Stat
-    records) — the complete per-frame receive work of the reference
-    client (lib/zk-buffer.js:275-442), interpreted Python in the
-    reference's idiom.  Returns (MiB/s, first decoded packet) — the
-    packet seeds the device full-decode correctness gate."""
+    opcode-dispatched body parse into packet dicts (data bytes, child
+    lists, ACLs, Stat records) — the complete per-frame receive work of
+    the reference client (lib/zk-buffer.js:275-442), interpreted Python
+    in the reference's idiom.  Returns (MiB/s, pkts) where ``pkts`` is
+    the per-frame packet list of the first CHECK_STREAMS streams — the
+    ground truth for the device full-decode differential gates."""
     from zkstream_tpu.protocol.framing import FrameDecoder
     from zkstream_tpu.protocol.jute import JuteReader
     from zkstream_tpu.protocol.records import read_response
 
     sub = streams[:SCALAR_FULL_STREAMS]
-    maps = _xid_maps(sub)
+    maps = _xid_maps(sub, slots)
     total = sum(len(s) for s in sub)
-    pkt0 = None
+    pkts = []
     t0 = time.perf_counter()
-    for s, m in zip(sub, maps):
+    for i, (s, m) in enumerate(zip(sub, maps)):
         dec = FrameDecoder(use_native=False)
         mm = dict(m)
-        for body in dec.feed(s):
-            pkt = read_response(JuteReader(body), mm)
-            if pkt0 is None:
-                pkt0 = pkt
+        row = [read_response(JuteReader(body), mm)
+               for body in dec.feed(s)]
+        if i < CHECK_STREAMS:
+            pkts.append(row)
     dt = time.perf_counter() - t0
-    return total / dt / (1024 * 1024), pkt0
+    return total / dt / (1024 * 1024), pkts
 
 
-def bench_ext_full(streams) -> float | None:
+def bench_ext_full(streams, slots) -> float | None:
     """The repo's own C-extension full decode over the same subset —
     context line so the flagship ratio is read against both the
     reference-idiom interpreted loop and this framework's native
@@ -189,7 +306,7 @@ def bench_ext_full(streams) -> float | None:
     from zkstream_tpu.protocol.consts import MAX_PACKET
 
     sub = streams[:SCALAR_FULL_STREAMS]
-    maps = _xid_maps(sub)
+    maps = _xid_maps(sub, slots)
     total = sum(len(s) for s in sub)
     t0 = time.perf_counter()
     for s, m in zip(sub, maps):
@@ -200,7 +317,8 @@ def bench_ext_full(streams) -> float | None:
     return total / dt / (1024 * 1024)
 
 
-def bench_tensor(buf, lens, pkt0) -> tuple[float, float, float]:
+def bench_tensor(buf, lens, streams, pkts, slots
+                 ) -> tuple[float, float, float]:
     """Tensor pipeline MiB/s on the default JAX device: the protocol
     tick (header decode + routing) and the **full decode** (tick +
     batched reply-body parse, ops/replies.py — the work of
@@ -245,21 +363,27 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float, float]:
         # frame, exactly the deployed device-bodies work
         st = wire_pipeline_step(b, l, max_frames=FRAMES)
         bd = parse_reply_bodies(b, st.starts, st.sizes,
-                                max_data=256, max_path=256)
+                                max_data=DEP_DATA, max_path=DEP_PATH)
         lb = parse_list_bodies(b, st.starts, st.sizes,
-                               max_children=16, max_name=64,
-                               max_acls=4, max_scheme=16, max_id=64)
+                               max_children=DEP_CHILDREN,
+                               max_name=DEP_NAME, max_acls=DEP_ACLS,
+                               max_scheme=DEP_SCHEME, max_id=DEP_ID)
         return st, bd, lb
 
+    # the CPU-fallback backend is ~3 orders slower than the chip per
+    # byte; fewer repeats keep a wedged-tunnel run inside the budget
+    # without changing what is measured (min-of-rounds either way)
+    reps = REPEATS if jax.default_backend() != 'cpu' \
+        else max(6, REPEATS // 3)
     candidates = [
         ('pallas', lambda b, l: wire_pipeline_step_pallas(
-            b, l, max_frames=FRAMES, block_rows=64), REPEATS),
+            b, l, max_frames=FRAMES, block_rows=64), reps),
         ('jnp', lambda b, l: wire_pipeline_step(
-            b, l, max_frames=FRAMES), REPEATS),
-        ('full', full, REPEATS),
+            b, l, max_frames=FRAMES), reps),
+        ('full', full, reps),
         # deployed widths cost ~20x the toy planes in output bytes;
         # fewer repeats keep the run inside the time/HBM budget
-        ('full-deployed', full_deployed, max(4, REPEATS // 5)),
+        ('full-deployed', full_deployed, max(4, reps // 5)),
     ]
     total = int(lens.sum())
     timed = []
@@ -295,16 +419,17 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float, float]:
         # dispatch): a decode mismatch must fail the benchmark, not
         # skip the path
         if name == 'full':
-            _gate_full_decode(out[:2], pkt0)
+            st, bd = out
+            _gate_planes(st, bd, None, slots)
+            _gate_differential(st, bd, None, pkts, slots,
+                               max_data=16, max_path=8)
             full_best = mibs
         elif name == 'full-deployed':
-            _gate_full_decode(out[:2], pkt0)
-            # the list planes must also have parsed: a GET_DATA body
-            # is not a children/ACL list, so the speculative parse
-            # flags every frame not-ok — the planes ran, found nothing
-            lb = out[2]
-            assert not bool(np.asarray(lb.ch_ok).any()), \
-                'list plane misparse'
+            st, bd, lb = out
+            _gate_planes(st, bd, lb, slots)
+            _gate_differential(st, bd, lb, pkts, slots,
+                               max_data=DEP_DATA, max_path=DEP_PATH)
+            _gate_list_agreement(lb, streams, slots)
             full_deployed_best = mibs
         else:
             assert int(np.asarray(out.n_frames).sum()) == B * FRAMES, \
@@ -320,33 +445,229 @@ def bench_tensor(buf, lens, pkt0) -> tuple[float, float, float]:
     return tick_best, full_best, full_deployed_best
 
 
-def _gate_full_decode(out, pkt0) -> None:
-    """The full-decode output must agree with the scalar codec: every
-    frame found, every data field located, every Stat parsed, and frame
-    (0, 0) equal field-for-field to the scalar codec's packet."""
-    from zkstream_tpu.ops.bytesops import i64pair_to_int
+def _host_planes(planes, n):
+    """First-``n``-streams host copy of a NamedTuple of [B, F, ...]
+    device planes (slice on device first: the full body planes are
+    GiB-scale and only the checked subset needs to come back)."""
+    return type(planes)(*[
+        _host_planes(x, n) if hasattr(x, '_fields')
+        else np.asarray(x[:n]) for x in planes])
 
-    st, bd = out
+
+def _gate_planes(st, bd, lb, slots) -> None:
+    """Plane-wide cheap gates over ALL streams: every frame found, and
+    every slot's [B, F] summary planes uniform at the corpus's known
+    per-slot ground truth (the per-byte field comparison happens on the
+    checked subset in :func:`_gate_differential`)."""
     assert int(np.asarray(st.n_frames).sum()) == B * FRAMES, \
         'full decode lost frames'
     data_len = np.asarray(bd.data_len)
-    assert (data_len == DATA_LEN).all(), 'full decode data_len mismatch'
-    valid = np.asarray(bd.stat_after_data.valid)
-    assert valid.all(), 'full decode Stat coverage mismatch'
-    sad = bd.stat_after_data
-    assert pkt0['opcode'] == 'GET_DATA'
-    s0 = pkt0['stat']
-    for fld in ('mzxid', 'czxid', 'pzxid', 'ctime', 'mtime'):
-        got = i64pair_to_int(
-            np.asarray(getattr(sad, fld + '_hi'))[0, 0],
-            np.asarray(getattr(sad, fld + '_lo'))[0, 0])
-        assert got == getattr(s0, fld), (fld, got, getattr(s0, fld))
-    for fld in ('version', 'cversion', 'aversion', 'dataLength',
-                'numChildren'):
-        got = int(np.asarray(getattr(sad, fld))[0, 0])
-        assert got == getattr(s0, fld), (fld, got, getattr(s0, fld))
-    got_data = bytes(np.asarray(bd.data)[0, 0, :DATA_LEN])
-    assert got_data == pkt0['data'], 'full decode data bytes mismatch'
+    data_ok = np.asarray(bd.data_ok)
+    sad_valid = np.asarray(bd.stat_after_data.valid)
+    for f, sl in enumerate(slots):
+        if sl['kind'] == 'data':
+            assert data_ok[:, f].all(), f'data_ok hole at slot {f}'
+            assert (data_len[:, f] == DATA_LEN).all(), \
+                f'data_len mismatch at slot {f}'
+            assert sad_valid[:, f].all(), f'Stat hole at slot {f}'
+    if lb is None:
+        return
+    ch_ok = np.asarray(lb.ch_ok)
+    ch_count = np.asarray(lb.ch_count)
+    sac_valid = np.asarray(lb.stat_after_children.valid)
+    acl_ok = np.asarray(lb.acl_ok)
+    acl_count = np.asarray(lb.acl_count)
+    saa_valid = np.asarray(lb.stat_after_acl.valid)
+    for f, sl in enumerate(slots):
+        if sl['kind'] in ('children', 'children2'):
+            n = CH2_N if sl['kind'] == 'children2' else CH_N
+            assert ch_ok[:, f].all(), f'ch_ok hole at slot {f}'
+            assert (ch_count[:, f] == n).all(), \
+                f'ch_count mismatch at slot {f}'
+            if sl['kind'] == 'children2':
+                assert sac_valid[:, f].all(), \
+                    f'children2 Stat hole at slot {f}'
+        elif sl['kind'] == 'acl':
+            assert acl_ok[:, f].all(), f'acl_ok hole at slot {f}'
+            assert (acl_count[:, f] == ACL_N).all(), \
+                f'acl_count mismatch at slot {f}'
+            assert saa_valid[:, f].all(), f'ACL Stat hole at slot {f}'
+
+
+def _gate_differential(st, bd, lb, pkts, slots, max_data: int,
+                       max_path: int) -> None:
+    """The differential gate (VERDICT r4 next #1): every frame of the
+    checked subset must decode field-for-field to what the scalar codec
+    (``records.read_response``) produced from the same bytes — headers,
+    payload bytes (up to the plane width, with the true length reported
+    either way), child lists, ACLs, notification fields, and Stats."""
+    from zkstream_tpu.ops.replies import stat_from_planes
+    from zkstream_tpu.protocol.consts import (
+        KeeperState,
+        NotificationType,
+    )
+
+    C = len(pkts)
+    xids = np.asarray(st.xids[:C])
+    errs = np.asarray(st.errs[:C])
+    b = _host_planes(bd, C)
+    lw = _host_planes(lb, C) if lb is not None else None
+    for i, row in enumerate(pkts):
+        assert len(row) == FRAMES
+        for f, pkt in enumerate(row):
+            sl = slots[f]
+            op = pkt['opcode']
+            assert op == sl['opcode'], (i, f, op)
+            assert int(xids[i, f]) == pkt['xid'], (i, f)
+            if pkt['err'] != 'OK':
+                assert sl['kind'] == 'data_err' and int(errs[i, f]) != 0
+                continue
+            assert int(errs[i, f]) == 0, (i, f)
+            if op == 'GET_DATA':
+                n = len(pkt['data'])
+                assert bool(b.data_ok[i, f])
+                assert int(b.data_len[i, f]) == n
+                k = min(n, max_data)
+                assert bytes(b.data[i, f, :k]) == pkt['data'][:k]
+                assert bool(b.stat_after_data.valid[i, f])
+                assert stat_from_planes(b.stat_after_data, i, f) \
+                    == pkt['stat'], (i, f)
+            elif op == 'NOTIFICATION':
+                assert NotificationType(int(b.ntype[i, f])).name \
+                    == pkt['type']
+                assert KeeperState(int(b.nstate[i, f])).name \
+                    == pkt['state']
+                path = pkt['path'].encode()
+                assert bool(b.npath_ok[i, f])
+                assert int(b.npath_len[i, f]) == len(path)
+                k = min(len(path), max_path)
+                assert bytes(b.npath[i, f, :k]) == path[:k]
+            elif op in ('GET_CHILDREN', 'GET_CHILDREN2'):
+                if lw is None:
+                    continue                 # toy run: no list planes
+                assert bool(lw.ch_ok[i, f]), (i, f)
+                cnt = int(lw.ch_count[i, f])
+                assert cnt == len(pkt['children'])
+                got = [bytes(lw.ch_bytes[i, f, k,
+                                         :int(lw.ch_len[i, f, k])]
+                             ).decode() for k in range(cnt)]
+                assert got == pkt['children'], (i, f)
+                if op == 'GET_CHILDREN2':
+                    assert bool(lw.stat_after_children.valid[i, f])
+                    assert stat_from_planes(
+                        lw.stat_after_children, i, f) == pkt['stat']
+            elif op == 'GET_ACL':
+                if lw is None:
+                    continue
+                assert bool(lw.acl_ok[i, f]), (i, f)
+                cnt = int(lw.acl_count[i, f])
+                assert cnt == len(pkt['acl'])
+                for k in range(cnt):
+                    want = pkt['acl'][k]
+                    assert int(lw.acl_perms[i, f, k]) == int(want.perms)
+                    assert bytes(lw.acl_scheme[
+                        i, f, k, :int(lw.acl_scheme_len[i, f, k])]
+                        ).decode() == want.id.scheme
+                    assert bytes(lw.acl_id[
+                        i, f, k, :int(lw.acl_id_len[i, f, k])]
+                        ).decode() == want.id.id
+                assert bool(lw.stat_after_acl.valid[i, f])
+                assert stat_from_planes(lw.stat_after_acl, i, f) \
+                    == pkt['stat']
+            elif op == 'PING':
+                pass
+            else:
+                raise AssertionError('unexpected opcode %r' % (op,))
+
+
+def _scalar_children_walk(body: bytes, max_children: int,
+                          max_name: int):
+    """The scalar codec's speculative children-list read, mirroring
+    exactly what the device plane promises to accept: a leading count
+    within the static bound, then count jute buffers, each fitting the
+    frame (negative length decodes as empty — the jute.py:182-183
+    quirk) and no longer than the name plane.  Returns the element
+    list, or None where the walk rejects."""
+    from zkstream_tpu.protocol.jute import JuteReader
+
+    r = JuteReader(body[16:])
+    try:
+        count = r.read_int()
+        if count < 0 or count > max_children:
+            return None
+        out = []
+        for _ in range(count):
+            e = r.read_buffer()
+            if len(e) > max_name:
+                return None
+            out.append(e)
+        return out
+    except Exception:
+        return None
+
+
+def _scalar_acl_walk(body: bytes, max_acls: int, max_scheme: int,
+                     max_id: int):
+    """Speculative ACL-list read with the device plane's bounds; see
+    :func:`_scalar_children_walk`."""
+    from zkstream_tpu.protocol.jute import JuteReader
+
+    r = JuteReader(body[16:])
+    try:
+        count = r.read_int()
+        if count < 0 or count > max_acls:
+            return None
+        out = []
+        for _ in range(count):
+            perms = r.read_int()
+            scheme = r.read_buffer()
+            ident = r.read_buffer()
+            if len(scheme) > max_scheme or len(ident) > max_id:
+                return None
+            out.append((perms, scheme, ident))
+        return out
+    except Exception:
+        return None
+
+
+def _gate_list_agreement(lb, streams, slots) -> None:
+    """The r4 failure's replacement (VERDICT r4 next #1): the list
+    planes' ok masks must agree with the scalar codec's speculative
+    read over the same bytes — INCLUDING coincidental accepts, where a
+    random GET_DATA payload legitimately parses as a list under the
+    negative-length=>empty quirk (~tens per million random frames; the
+    r4 gate wrongly asserted zero and could never pass).  Checked over
+    the scalar-subset streams: device-accept => scalar-accept with the
+    same element count, and scalar ground truth (the corpus's genuine
+    list slots) => device-accept, verified plane-wide in
+    :func:`_gate_planes`."""
+    C = min(SCALAR_FULL_STREAMS, len(streams))
+    ch_ok = np.asarray(lb.ch_ok[:C])
+    ch_count = np.asarray(lb.ch_count[:C])
+    acl_ok = np.asarray(lb.acl_ok[:C])
+    acl_count = np.asarray(lb.acl_count[:C])
+    n_coincident = 0
+    for i in range(C):
+        s = streams[i]
+        for f in np.nonzero(ch_ok[i])[0]:
+            sl = slots[f]
+            body = s[sl['off'] + 4:sl['off'] + 4 + sl['body_len']]
+            walk = _scalar_children_walk(body, DEP_CHILDREN, DEP_NAME)
+            assert walk is not None, \
+                ('device ch_ok but scalar walk rejects', i, int(f))
+            assert len(walk) == int(ch_count[i, f]), (i, int(f))
+            if sl['kind'] not in ('children', 'children2'):
+                n_coincident += 1
+        for f in np.nonzero(acl_ok[i])[0]:
+            sl = slots[f]
+            body = s[sl['off'] + 4:sl['off'] + 4 + sl['body_len']]
+            walk = _scalar_acl_walk(body, DEP_ACLS, DEP_SCHEME, DEP_ID)
+            assert walk is not None, \
+                ('device acl_ok but scalar walk rejects', i, int(f))
+            assert len(walk) == int(acl_count[i, f]), (i, int(f))
+    print('# list-plane agreement: %d coincidental accepts over %d '
+          'frames, all scalar-confirmed' % (n_coincident, C * FRAMES),
+          file=sys.stderr)
 
 
 CLIENT_SCALES = (32, 128)  # fleet sizes for the runtime bench (the
@@ -632,17 +953,18 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - environment-specific
         print('# cpu backend unavailable: %s' % (e,), file=sys.stderr)
 
-    buf, lens, streams = _fleet()
+    buf, lens, streams, slots = _fleet()
     scalar = bench_scalar(streams)
-    scalar_full, pkt0 = bench_scalar_full(streams)
-    ext_full = bench_ext_full(streams)
-    tick, full, full_deployed = bench_tensor(buf, lens, pkt0)
+    scalar_full, pkts = bench_scalar_full(streams, slots)
+    ext_full = bench_ext_full(streams, slots)
+    tick, full, full_deployed = bench_tensor(buf, lens, streams,
+                                             pkts, slots)
     print(f'# scalar tick baseline: {scalar:.2f} MiB/s over {B} '
           f'streams x {FRAMES} frames (headers only, equal work)',
           file=sys.stderr)
     print(f'# scalar full-decode baseline: {scalar_full:.2f} MiB/s '
           f'over {SCALAR_FULL_STREAMS} streams (framing + header + '
-          f'body -> packet dicts)', file=sys.stderr)
+          f'body -> packet dicts, mixed opcodes)', file=sys.stderr)
     if ext_full is not None:
         print(f'# C-extension full decode: {ext_full:.2f} MiB/s '
               f'(this framework\'s own native scalar path)',
@@ -691,6 +1013,8 @@ def main() -> None:
         'unit': 'MiB/s',
         'vs_baseline': round(full_deployed / scalar_full, 3),
         'widths': 'data256/path256/ch16x64/acl4',
+        'corpus': 'mixed-opcode %dx%d (data/children/acl/notif/'
+                  'err/ping)' % (B, FRAMES),
         'toy_width_mibs': round(full, 2),
         'backend': backend,
     }), flush=True)
