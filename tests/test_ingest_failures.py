@@ -125,7 +125,7 @@ def test_loop_closed_mid_compile_is_contained():
         loop.close()          # close BEFORE the compile finishes
     release.set()
     assert done.wait(10)
-    ing._warm_pool.shutdown(wait=True)   # worker exits cleanly
+    ing._warm_queue.join()    # daemon worker finished the task cleanly
     # the result could not be delivered: the bucket is still unwarmed
     assert ing._exec == {}
 
@@ -372,7 +372,9 @@ async def test_fragmentation_guard_enters_and_exits():
     regime): a large fleet whose ticks are sparse routes to the scalar
     drain; when ticks become batches again the device path resumes —
     with hysteresis in between."""
-    ing = mk_ingest()             # bypass_bytes=0, warm='block'
+    # the guard must be requested explicitly here: bypass_bytes=0
+    # auto-disables it (force-device means force-device)
+    ing = mk_ingest(frag_guard=True)   # bypass_bytes=0, warm='block'
     ing.FRAG_MIN_FLEET = 8        # scale the guard to a test fleet
     await ing.prewarm(8)
     conns = [FakeConn() for _ in range(8)]
@@ -462,3 +464,30 @@ async def test_direct_and_batch_regimes_deliver_identically():
     batch = await run(mk_ingest(bypass_bytes=0))         # always batch
     assert direct == batch
     assert sum(len(x) for x in direct) == len(plan)
+
+
+async def test_force_device_auto_disables_frag_guard():
+    """bypass_bytes=0 promises every tick on the device pipeline
+    (tests, benchmarks); under frag_guard auto (the default) that
+    promise now extends to the fragmentation guard (r4 advisor
+    finding: sweep_crossover had to pass frag_guard=False by hand)."""
+    assert mk_ingest().frag_guard is False          # bypass_bytes=0
+    assert mk_ingest(frag_guard=True).frag_guard is True   # pinned
+    assert FleetIngest().frag_guard is True         # production default
+    assert FleetIngest(frag_guard=False).frag_guard is False
+
+
+async def test_background_warm_thread_is_daemon():
+    """The warm worker must be a daemon thread: a compile wedged on an
+    unreachable accelerator backend (documented prewarm hazard) must
+    not hang interpreter exit — which a ThreadPoolExecutor's
+    non-daemon worker, joined by concurrent.futures atexit, would
+    (r4 advisor finding)."""
+    import threading
+
+    ing = mk_ingest(warm='background')
+    ev = ing._start_warm(ing._bucket(2, ing.min_len))
+    warm = [t for t in threading.enumerate()
+            if t.name == 'ingest-warm']
+    assert warm and all(t.daemon for t in warm)
+    await asyncio.wait_for(ev.wait(), 60)

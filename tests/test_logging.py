@@ -97,6 +97,43 @@ def test_wrapping_a_logger_facade_merges():
     assert outer.context == {'a': 1, 'b': 2}
 
 
+def test_exception_appends_active_traceback():
+    lg = logging.getLogger('zkstream_tpu.test.exc1')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        try:
+            raise ValueError('boom')
+        except ValueError:
+            Logger(lg).exception('tick failed %d', 7)
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    msg = rec.getMessage()
+    assert msg.startswith('tick failed 7')
+    assert 'ValueError: boom' in msg
+
+
+def test_exception_outside_except_block_logs_plain_error():
+    """logging.exception() with no active exception must not append
+    the confusing 'NoneType: None' tail format_exc() produces outside
+    an except block (r4 advisor finding)."""
+    lg = logging.getLogger('zkstream_tpu.test.exc2')
+    lg.setLevel(1)
+    cap = _Capture()
+    lg.addHandler(cap)
+    try:
+        Logger(lg).exception('no active exception here')
+    finally:
+        lg.removeHandler(cap)
+    (rec,) = cap.records
+    msg = rec.getMessage()
+    assert rec.levelno == logging.ERROR
+    assert msg == 'no active exception here'
+    assert 'NoneType' not in msg
+
+
 async def test_client_stack_accretes_context(server):
     """Connection records carry zkAddress/zkPort; once the session is
     up, session and connection records carry sessionId."""

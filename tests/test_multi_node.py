@@ -336,3 +336,39 @@ async def test_sync_through_batched_ingest(ensemble):
     assert ing.ticks > 0               # the device plane carried it
     await c1.close()
     await c2.close()
+
+
+async def test_commit_log_truncates_once_applied_everywhere():
+    """The leader's commit log must not grow without bound on a
+    long-running ensemble: the prefix every attached replica has
+    applied is dropped (in chunks), while a deliberately-held replica
+    pins exactly the history it still needs."""
+    from zkstream_tpu.protocol.consts import CreateFlag
+    from zkstream_tpu.protocol.records import OPEN_ACL_UNSAFE
+    from zkstream_tpu.server.store import ReplicaStore, ZKDatabase
+
+    leader = ZKDatabase()
+    live = ReplicaStore(leader, lag=0.0)
+    held = ReplicaStore(leader, lag=None)       # applies on catch_up
+
+    n = 3 * ZKDatabase.LOG_TRUNC_CHUNK
+    for i in range(n):
+        leader.create('/n%d' % i, b'payload-%d' % i,
+                      OPEN_ACL_UNSAFE, CreateFlag(0))
+    # the held replica pins the whole history
+    assert held.applied == 0 and live.applied == n
+    assert leader.log_base == 0 and len(leader.log) == n
+
+    held.catch_up()
+    assert held.applied == n
+    # the next commit triggers the truncation sweep
+    leader.create('/last', b'', OPEN_ACL_UNSAFE, CreateFlag(0))
+    assert leader.log_base >= n
+    assert len(leader.log) <= 1 + ZKDatabase.LOG_TRUNC_CHUNK
+    assert leader.log_end() == n + 1
+
+    # both replicas converged on the leader's tree
+    for store in (live, held):
+        store.catch_up()
+        assert store.nodes.keys() == leader.nodes.keys()
+        assert store.nodes['/n7'].data == b'payload-7'

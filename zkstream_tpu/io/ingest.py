@@ -59,8 +59,8 @@ max(scalar drain, steady device tick), never a compile
 from __future__ import annotations
 
 import asyncio
+import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -109,8 +109,12 @@ class FleetIngest:
         never blocks on a compile; ``'block'`` — compile inline on
         first use (deterministic; tests/tools).
       frag_guard: route fragmented mega-fleet ticks back to the scalar
-        drain (see the attribute comment below).  Default True; the
-        mesh proxy disables it.
+        drain (see the attribute comment below).  Default ``None`` =
+        auto: enabled for production thresholds, disabled when
+        ``bypass_bytes=0`` (force-device: tests, benchmarks — "every
+        tick on the device pipeline" must mean exactly that).  Pass
+        ``True``/``False`` to pin it either way; the mesh proxy
+        disables it.
       log: parent logger.
     """
 
@@ -133,7 +137,7 @@ class FleetIngest:
                  latency_budget_ms: float = 5.0,
                  bypass_bytes: int = 16384,
                  warm: str = 'background',
-                 frag_guard: bool = True,
+                 frag_guard: bool | None = None,
                  log: Logger | None = None):
         assert body_mode in ('host', 'device'), body_mode
         assert placement in ('auto', 'accelerator', 'host'), placement
@@ -160,8 +164,11 @@ class FleetIngest:
         #: path being the spec, and none of the batching overhead the
         #: r4 re-sweep measured costing 10-24% when the old design
         #: still accumulated + tick-drained in this regime.  0 forces
-        #: every tick onto the device pipeline (tests, benchmarks).
-        #: Default 16 KiB = the measured parity point (~128
+        #: every tick onto the device pipeline (tests, benchmarks) —
+        #: including disabling the fragmentation guard, which would
+        #: otherwise still divert >=600-connection fragmented fleets
+        #: to the scalar drain.  Default 16 KiB = the measured parity
+        #: point (~128
         #: connections x ~135 B frames, CROSSOVER.md): below it the
         #: scalar drain wins outright; above it the device path is
         #: free e2e and adds the stats plane + device bodies +
@@ -200,8 +207,12 @@ class FleetIngest:
         #: because fragmented mega-fleets still clear 16 KiB/tick).
         #: An EMA of frames routed per tick, compared against the
         #: registered fleet size with hysteresis, routes those ticks
-        #: back to the scalar drain.
-        self.frag_guard = frag_guard
+        #: back to the scalar drain.  Auto (None): enabled only with a
+        #: production byte threshold — ``bypass_bytes=0`` (force-device:
+        #: tests, benchmarks) must mean every tick on the device
+        #: pipeline, so auto disables the guard there.
+        self.frag_guard = (bypass_bytes > 0 if frag_guard is None
+                           else frag_guard)
         self._ema_frames: float | None = None
         self._frag_scalar = False
         #: Regime flag: in DIRECT mode ``feed`` delivers through the
@@ -231,7 +242,7 @@ class FleetIngest:
         #: executor (created lazily): a load pattern hopping several
         #: (Bp, L) buckets at once must not stack ~1 s XLA compiles
         #: concurrently on the host that is also serving scalar ticks
-        self._warm_pool: ThreadPoolExecutor | None = None
+        self._warm_queue: queue.Queue | None = None
 
     # -- connection registry --
 
@@ -487,18 +498,40 @@ class FleetIngest:
     def _start_warm(self, key: tuple) -> asyncio.Event:
         """Queue (or join) the background compile for ``key``;
         returns the event set when the bucket is ready (or failed).
-        Compiles drain FIFO through a one-thread executor, so at most
-        one XLA compile runs at any moment and a failure is contained
-        to its task (never to the serialization mechanism)."""
+        Compiles drain FIFO through one DAEMON worker thread, so at
+        most one XLA compile runs at any moment, a failure is contained
+        to its task (never to the serialization mechanism), and — the
+        reason it must be a daemon, not an executor worker — a compile
+        wedged on an unreachable accelerator backend can never hang
+        interpreter exit (concurrent.futures joins its non-daemon
+        workers at shutdown; a daemon thread just dies)."""
         ev = self._warm_events.get(key)
         if ev is not None:
             return ev
         ev = asyncio.Event()
         self._warm_events[key] = ev
         loop = asyncio.get_running_loop()
-        if self._warm_pool is None:
-            self._warm_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix='ingest-warm')
+        if self._warm_queue is None:
+            q = self._warm_queue = queue.Queue()
+
+            # the drain closure must reference only the QUEUE, never
+            # self: a thread parked in q.get() would otherwise pin the
+            # whole ingest (compiled executables included) for the
+            # process lifetime; None is the close() shutdown sentinel
+            def drain():
+                while True:
+                    task = q.get()
+                    try:
+                        if task is None:
+                            return
+                        task()
+                    except Exception:   # containment; _try_compile
+                        pass            # already latches failures
+                    finally:
+                        q.task_done()
+
+            threading.Thread(target=drain, daemon=True,
+                             name='ingest-warm').start()
 
         def work():
             ex = self._try_compile(key)
@@ -514,8 +547,19 @@ class FleetIngest:
             except RuntimeError:     # loop closed mid-compile
                 pass
 
-        self._warm_pool.submit(work)
+        self._warm_queue.put(work)
         return ev
+
+    def close(self) -> None:
+        """Release the background warm worker (idempotent).  Queued
+        compiles still drain first (FIFO), then the daemon thread
+        exits; without this the parked worker lives until process
+        exit — harmless (it holds only the queue, never the ingest)
+        but untidy in thread dumps.  The ingest itself needs no other
+        teardown: connections unregister themselves."""
+        if self._warm_queue is not None:
+            self._warm_queue.put(None)
+            self._warm_queue = None
 
     def bind_metrics(self, collector, prefix: str = '') -> None:
         """Expose this ingest's tick/frame counters as pull-model
@@ -1040,10 +1084,11 @@ class FleetIngest:
                 pkt['stat'] = stat_from_planes(
                     bd.stat_after_children, i, f)
             cnt = int(bd.ch_count[i, f])
+            # plane contract: ch_ok => lens already clamped to [0, S]
             lens = bd.ch_len[i, f, :cnt].tolist()
             row, S = bd.ch_bytes[i, f], self.max_name
             pkt['children'] = [
-                bytes(row[k * S:k * S + max(lens[k], 0)]).decode()
+                bytes(row[k * S:k * S + lens[k]]).decode()
                 for k in range(cnt)]
             return True
         if opcode == 'GET_ACL':
@@ -1061,10 +1106,8 @@ class FleetIngest:
             irow, SI = bd.acl_id[i, f], self.max_id
             pkt['acl'] = [
                 ACL(Perm(perms[k]), Id(
-                    bytes(srow[k * SS:k * SS + max(slens[k], 0)]
-                          ).decode(),
-                    bytes(irow[k * SI:k * SI + max(ilens[k], 0)]
-                          ).decode()))
+                    bytes(srow[k * SS:k * SS + slens[k]]).decode(),
+                    bytes(irow[k * SI:k * SI + ilens[k]]).decode()))
                 for k in range(cnt)]
             pkt['stat'] = stat_from_planes(bd.stat_after_acl, i, f)
             return True

@@ -206,9 +206,13 @@ class ZKDatabase(NodeTree):
         #: The commit log: every mutation, in zxid order, as a
         #: self-contained entry a :class:`ReplicaStore` can replay.
         #: Only kept once a replica attaches — a standalone server
-        #: must not retain every payload for the process lifetime.
+        #: must not retain every payload for the process lifetime —
+        #: and truncated as all replicas apply (``log[0]`` is absolute
+        #: index ``log_base``), so a long-running ensemble does not
+        #: grow memory without bound either.
         self.log: list[tuple] = []
-        self._replicated = False
+        self.log_base = 0
+        self._replicas: list['ReplicaStore'] = []
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
         self._next_session = ((int(time.time() * 1000) << 24)
                               & 0x7fffffffffff0000)
@@ -226,7 +230,11 @@ class ZKDatabase(NodeTree):
     def catch_up(self) -> None:
         """The leader is always caught up (uniform member interface)."""
 
-    def attach_replica(self) -> None:
+    #: Truncate the applied-everywhere log prefix in chunks (a del of
+    #: a list prefix is O(surviving entries) — amortize it).
+    LOG_TRUNC_CHUNK = 256
+
+    def attach_replica(self, replica: 'ReplicaStore') -> None:
         """Called by :class:`ReplicaStore` — from here on, committed
         transactions are retained in ``log`` for replay.  Must happen
         before the first transaction: a replica cannot replay history
@@ -235,12 +243,27 @@ class ZKDatabase(NodeTree):
             raise ValueError(
                 'replica attached after %d transactions; the commit '
                 'log only starts recording at attach' % (self.zxid,))
-        self._replicated = True
+        self._replicas.append(replica)
+
+    def log_end(self) -> int:
+        """Absolute index one past the newest log entry."""
+        return self.log_base + len(self.log)
 
     def _commit(self, entry: tuple) -> None:
-        if self._replicated:
+        if self._replicas:
             self.log.append(entry)
             self.emit('committed')
+            self._truncate_applied()
+
+    def _truncate_applied(self) -> None:
+        """Drop the log prefix every attached replica has applied —
+        those entries can never be replayed again (``applied`` only
+        advances), so retaining them would grow a long-running
+        ensemble's memory without bound."""
+        floor = min(r.applied for r in self._replicas)
+        if floor - self.log_base >= self.LOG_TRUNC_CHUNK:
+            del self.log[:floor - self.log_base]
+            self.log_base = floor
 
     # -- session lifecycle --
 
@@ -393,27 +416,29 @@ class ReplicaStore(NodeTree):
         super().__init__()
         self.leader = leader
         self.lag = lag
-        #: index into ``leader.log`` of the next entry to apply
+        #: ABSOLUTE index (leader.log_base frame) of the next entry to
+        #: apply; only ever advances, which is what lets the leader
+        #: truncate the applied-everywhere prefix
         self.applied = 0
-        leader.attach_replica()
+        leader.attach_replica(self)
         leader.on('committed', self._on_commit)
 
     def _on_commit(self) -> None:
         if self.lag is None:
             return
         if self.lag <= 0:
-            self._apply_until(len(self.leader.log))
+            self._apply_until(self.leader.log_end())
         else:
             ambient_loop().call_later(
-                self.lag, self._apply_until, len(self.leader.log))
+                self.lag, self._apply_until, self.leader.log_end())
 
     def _apply_until(self, target: int) -> None:
-        """Apply log entries up to index ``target`` (idempotent: a
-        timer firing after a ``catch_up`` already passed it is a
-        no-op, so application order is always log order)."""
-        log_ = self.leader.log
-        while self.applied < min(target, len(log_)):
-            self._apply_one(log_[self.applied])
+        """Apply log entries up to absolute index ``target``
+        (idempotent: a timer firing after a ``catch_up`` already passed
+        it is a no-op, so application order is always log order)."""
+        ldr = self.leader
+        while self.applied < min(target, ldr.log_end()):
+            self._apply_one(ldr.log[self.applied - ldr.log_base])
             self.applied += 1
 
     def _apply_one(self, entry: tuple) -> None:
@@ -433,4 +458,4 @@ class ReplicaStore(NodeTree):
         """Apply everything committed so far — the ``sync`` op's
         flush, and what a write through this member does so its
         author can read their own write."""
-        self._apply_until(len(self.leader.log))
+        self._apply_until(self.leader.log_end())

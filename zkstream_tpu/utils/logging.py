@@ -94,8 +94,14 @@ class Logger:
         loop alive (e.g. the multihost cadence) but must not hide it."""
         if not self.base.isEnabledFor(_logging.ERROR):
             return
+        import sys
         import traceback
 
+        if sys.exc_info()[0] is None:
+            # no active exception: format_exc() would append a
+            # confusing 'NoneType: None' tail — plain error instead
+            self._log(_logging.ERROR, '%s', self._render(msg, args))
+            return
         # render the caller's args FIRST so a literal '%' in the
         # rendered message cannot collide with the traceback's %s slot
         # (same invariant _log keeps for context suffixes)
