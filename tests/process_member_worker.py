@@ -1,0 +1,66 @@
+"""One ensemble-member OS process (run via subprocess by
+tests/test_process_ensemble.py; not collected by pytest).
+
+Roles:
+  leader                 — ZKDatabase + leader-member ZKServer +
+                           ReplicationService; prints
+                           ``READY <client_port> <repl_port>``.
+  follower <host> <port> — RemoteLeader control/events channels to the
+                           leader's replication port + a full ZKServer
+                           serving clients from a RemoteReplicaStore;
+                           prints ``READY <client_port>``.
+
+Both run until killed — being SIGKILLed mid-service is the point of
+the tier (reference: test/multi-node.test.js:309-338 kills real server
+processes; test/zkserver.js:236-264 hunts child PIDs)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+
+async def run_leader() -> None:
+    from zkstream_tpu.server.replication import ReplicationService
+    from zkstream_tpu.server.server import ZKServer
+    from zkstream_tpu.server.store import ZKDatabase
+
+    db = ZKDatabase()
+    member = await ZKServer(db).start()
+    repl = await ReplicationService(db).start()
+    print('READY %d %d' % (member.port, repl.port), flush=True)
+    await asyncio.Event().wait()
+
+
+async def run_follower(leader_host: str, leader_port: int) -> None:
+    from zkstream_tpu.server.replication import (
+        RemoteLeader,
+        RemoteReplicaStore,
+    )
+    from zkstream_tpu.server.server import ZKServer
+
+    remote = await RemoteLeader(leader_host, leader_port).connect()
+    store = RemoteReplicaStore(remote, lag=0.0)
+    member = await ZKServer(remote, store=store).start()
+    print('READY %d' % (member.port,), flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> int:
+    # keep jax fully out of the picture: the server stack is pure
+    # asyncio, and the image's site hook must not touch a (possibly
+    # wedged) accelerator plugin from these workers
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    role = sys.argv[1]
+    if role == 'leader':
+        asyncio.run(run_leader())
+    else:
+        assert role == 'follower', role
+        asyncio.run(run_follower(sys.argv[2], int(sys.argv[3])))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

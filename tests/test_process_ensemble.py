@@ -1,0 +1,173 @@
+"""Process-isolated ensemble tier (VERDICT r4 next #4): each member is
+its own OS process, and the member holding the session dies by SIGKILL
+— the OS severs the client's TCP connection, not a cooperative close —
+while the session, its ephemeral, and its watches survive on the rest
+of the ensemble.  The rebuild's version of the reference experiment at
+test/multi-node.test.js:233-350 (three real server processes; kills in
+test/zkserver.js:236-264)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__),
+                      'process_member_worker.py')
+
+
+class Member:
+    def __init__(self, proc: subprocess.Popen, ports: list[int]):
+        self.proc = proc
+        self.ports = ports
+
+
+def _spawn(*args: str) -> Member:
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, *args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith('READY '), (args, line)
+    return Member(proc, [int(x) for x in line.split()[1:]])
+
+
+@pytest.fixture
+def process_ensemble():
+    """A leader process + two follower processes; yields
+    (leader, [follower1, follower2]).  SIGKILLs everything left at
+    teardown."""
+    members: list[Member] = []
+    leader = _spawn('leader')
+    members.append(leader)
+    try:
+        for _ in range(2):
+            members.append(_spawn('follower', '127.0.0.1',
+                                  str(leader.ports[1])))
+        yield leader, members[1:]
+    finally:
+        for m in members:
+            if m.proc.poll() is None:
+                m.proc.kill()
+            m.proc.wait()
+            m.proc.stdout.close()
+
+
+def _client(addrs, **kw):
+    from zkstream_tpu import Client
+
+    kw.setdefault('session_timeout', 12000)
+    c = Client(servers=addrs, shuffle_backends=False, **kw)
+    c.start()
+    return c
+
+
+async def _retrying(coro_fn, attempts=20, delay=0.25):
+    last = None
+    for _ in range(attempts):
+        try:
+            return await coro_fn()
+        except Exception as e:        # reconnect churn mid-failover
+            last = e
+            await asyncio.sleep(delay)
+    raise last
+
+
+async def test_sigkill_member_session_and_watches_survive(
+        process_ensemble):
+    """The reference experiment: kill -9 the member serving the
+    session; the client reconnects to another member, resumes the SAME
+    session (no 'expire', no fresh 'session'), its ephemeral is intact,
+    and a re-armed watch still fires (multi-node.test.js:233-350)."""
+    from zkstream_tpu.protocol.consts import CreateFlag
+
+    leader, (f1, f2) = process_ensemble
+    others = [('127.0.0.1', f2.ports[0]), ('127.0.0.1', leader.ports[0])]
+    c1 = _client([('127.0.0.1', f1.ports[0])] + others)
+    c2 = _client(list(reversed(others)))
+    events: list[str] = []
+    for ev in ('session', 'connect', 'disconnect', 'expire', 'failed'):
+        c1.on(ev, lambda *a, ev=ev: events.append(ev))
+    try:
+        await c1.wait_connected(timeout=10)
+        await c2.wait_connected(timeout=10)
+        sid = c1.session.get_session_id()
+        await c1.create('/eph', b'mine', flags=CreateFlag.EPHEMERAL)
+        await c1.create('/watched', b'v0')
+
+        fired: asyncio.Future = asyncio.get_running_loop().create_future()
+
+        def on_change(*a):
+            if not fired.done():
+                fired.set_result(a)
+
+        c1.watcher('/watched').on('dataChanged', on_change)
+        await asyncio.sleep(0.3)       # arm (and swallow arm-time emit)
+        events.clear()
+
+        # the OS, not a cooperative close, severs the connection
+        os.kill(f1.proc.pid, signal.SIGKILL)
+        f1.proc.wait()
+
+        # the session resumes on a surviving member within the timeout
+        st = await _retrying(lambda: c1.stat('/eph'))
+        assert st is not None
+        assert c1.session.get_session_id() == sid, \
+            'session did not survive the SIGKILL'
+        assert 'disconnect' in events and 'connect' in events, events
+        assert 'expire' not in events and 'session' not in events, events
+
+        # the ephemeral survives — its session never expired
+        data, _ = await c2.get('/eph')
+        assert data == b'mine'
+
+        # the re-armed watch still fires, through the new member
+        await c2.set('/watched', b'v1')
+        got = await asyncio.wait_for(fired, 10)
+        assert got, 'watch lost across the SIGKILL failover'
+        data, _ = await c1.get('/watched')
+        assert data == b'v1'
+    finally:
+        await c1.close()
+        await c2.close()
+
+
+async def test_process_members_replicate_and_sync(process_ensemble):
+    """Plumbing check for the tier itself: a write through one OS
+    process is readable through another after sync, and sequential
+    numbering stays leader-global across processes."""
+    from zkstream_tpu.protocol.consts import CreateFlag
+
+    leader, (f1, f2) = process_ensemble
+    c1 = _client([('127.0.0.1', f1.ports[0])])
+    c2 = _client([('127.0.0.1', f2.ports[0])])
+    try:
+        await c1.wait_connected(timeout=10)
+        await c2.wait_connected(timeout=10)
+        await c1.create('/x', b'hello')
+        await c2.sync('/x')
+        data, stat = await c2.get('/x')
+        assert data == b'hello' and stat.version == 0
+        p1 = await c1.create('/s-', b'', flags=CreateFlag.SEQUENTIAL)
+        p2 = await c2.create('/s-', b'', flags=CreateFlag.SEQUENTIAL)
+        assert p1 == '/s-0000000000' and p2 == '/s-0000000001'
+        await c1.set('/x', b'world')
+        await c2.sync('/x')
+        data, stat = await c2.get('/x')
+        assert data == b'world' and stat.version == 1
+
+        # push past LOG_TRUNC_CHUNK commits so the leader's truncation
+        # sweep runs UNDER the control-channel piggyback: acks (not
+        # shipments) gate the floor, so forwarded writes must keep
+        # working throughout
+        for i in range(300):
+            await c1.set('/x', b'w%d' % i)
+        await c2.sync('/x')
+        data, stat = await c2.get('/x')
+        assert data == b'w299' and stat.version == 301
+    finally:
+        await c1.close()
+        await c2.close()

@@ -1,7 +1,13 @@
-"""In-process ZooKeeper server: data model, wire server, and ensemble
-simulation (the rebuild's replacement for the reference's JVM-spawning
-test harness, test/zkserver.js)."""
+"""In-process ZooKeeper server: data model, wire server, ensemble
+simulation, and cross-process member replication (the rebuild's
+replacement for the reference's JVM-spawning test harness,
+test/zkserver.js)."""
 
+from .replication import (  # noqa: F401
+    RemoteLeader,
+    RemoteReplicaStore,
+    ReplicationService,
+)
 from .server import ServerConnection, ZKEnsemble, ZKServer  # noqa: F401
 from .store import (  # noqa: F401
     NodeTree,

@@ -1,0 +1,456 @@
+"""Cross-process ensemble members: commit-log replication over TCP.
+
+The in-process ``ZKEnsemble`` shares one ``ZKDatabase`` object between
+its members, so killing a member is necessarily a cooperative close —
+half-written frames, dead-socket detection and OS-level connection
+resets are never exercised.  The reference's multi-node tier runs three
+genuinely separate server processes and kills them with signals
+(reference: test/multi-node.test.js:23-39,309-338; test/zkserver.js
+hunts child PIDs for a clean kill).  This module gives the rebuild the
+same tier: a **leader process** exporting its ``ZKDatabase`` over a
+replication service, and **follower processes** running a full
+``ZKServer`` whose leader-side operations forward over TCP while reads
+and watches are served from a local :class:`~.store.ReplicaStore`
+replaying the mirrored commit log — so ``SIGKILL`` on any follower
+severs real client sockets at the OS level, and the session state the
+clients depend on survives in the leader process, exactly the
+single-leader replication model store.py already implements in-process.
+
+Two channels per follower, paired by a token:
+
+- ``control`` — a *blocking* socket the follower's request handlers
+  call RPCs on (create/delete/set_data, session lifecycle, sync
+  barrier).  Every response piggybacks the commit-log entries the
+  follower has not mirrored yet, so a write-then-read through one
+  member observes its own write without waiting on the async stream.
+- ``events`` — an asyncio stream the leader pushes to: new commit-log
+  entries as they land, and session-expiry broadcasts.
+
+Wire format: 4-byte big-endian length + pickle.  Pickle is safe here
+for the same reason the reference can shell out to a local JVM: both
+ends are the same trusted test harness on one machine; this service
+must never listen on a non-loopback interface.
+
+Limitations (documented, deliberate): the leader process is the quorum
+— killing it kills the ensemble (no election); a SIGKILLed follower
+stays dead (re-attach of a non-empty replica is not supported by the
+attach-before-first-transaction invariant, store.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import socket
+import struct
+import threading
+
+from ..protocol.consts import CreateFlag
+from ..utils.events import EventEmitter
+from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
+
+log = logging.getLogger('zkstream_tpu.server.replication')
+
+_LEN = struct.Struct('>I')
+
+
+def _dump(msg) -> bytes:
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    return _LEN.pack(len(payload)) + payload
+
+
+async def _read_msg(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(4)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(await reader.readexactly(n))
+
+
+def _recv_msg(sock: socket.socket):
+    buf = b''
+    while len(buf) < 4:
+        chunk = sock.recv(4 - len(buf))
+        if not chunk:
+            raise ConnectionError('replication control channel closed')
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    out = b''
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError('replication control channel closed')
+        out += chunk
+    return pickle.loads(out)
+
+
+class _FollowerHandle:
+    """The leader-side stand-in for one remote follower in the
+    database's replica registry.  ``applied`` is what the follower has
+    ACKED as mirrored (never merely shipped): the truncation floor must
+    stay at or below every index a control-channel piggyback may still
+    be asked to serve from — a follower whose event loop is momentarily
+    blocked must not have the log truncated out from under its next
+    RPC.  ``shipped`` tracks the push cursor separately."""
+
+    def __init__(self, token: str):
+        self.token = token
+        self.applied = 0
+        self.shipped = 0
+        self.writer: asyncio.StreamWriter | None = None
+
+
+class ReplicationService:
+    """Leader-process side.  Owns no sockets of the ZK protocol — it
+    serves follower processes, not clients; run a normal ``ZKServer``
+    on the same ``db`` for the leader *member*."""
+
+    def __init__(self, db: ZKDatabase, host: str = '127.0.0.1',
+                 port: int = 0):
+        self.db = db
+        self.host = host
+        self.port = port
+        self._server: asyncio.base_events.Server | None = None
+        self._handles: dict[str, _FollowerHandle] = {}
+        self._subscribed = False
+
+    async def start(self) -> 'ReplicationService':
+        self._server = await asyncio.start_server(
+            self._on_follower, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if not self._subscribed:
+            self.db.on('committed', self._push_commits)
+            self.db.on('sessionExpired', self._push_expiry)
+            self._subscribed = True
+        log.info('replication service on %s:%d', self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- pushes (events channel) --
+
+    def _entries_from(self, have: int) -> tuple[int, list]:
+        db = self.db
+        assert have >= db.log_base, (have, db.log_base)
+        return have, db.log[have - db.log_base:]
+
+    def _push(self, handle: _FollowerHandle, msg) -> None:
+        if handle.writer is None:
+            return
+        try:
+            handle.writer.write(_dump(msg))
+        except (ConnectionError, RuntimeError):
+            pass
+
+    def _push_commits(self) -> None:
+        for h in self._handles.values():
+            base, entries = self._entries_from(h.shipped)
+            if entries:
+                self._push(h, ('commit', base, entries))
+                h.shipped = base + len(entries)
+
+    def _push_expiry(self, session_id: int) -> None:
+        for h in self._handles.values():
+            self._push(h, ('session_expired', session_id))
+
+    # -- per-follower connections --
+
+    async def _on_follower(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            hello = await _read_msg(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        kind, token = hello[0], hello[1]
+        if kind == 'events':
+            h = self._handles.get(token)
+            if h is None:
+                h = _FollowerHandle(token)
+                try:
+                    self.db.attach_replica(h)
+                except ValueError as e:
+                    # a late joiner (e.g. a restarted follower after
+                    # history began) is REJECTED loudly, not wedged
+                    # silently on an empty tree
+                    log.error('rejecting follower %s: %s', token, e)
+                    try:
+                        writer.write(_dump(('reject', str(e))))
+                        await writer.drain()
+                    except (ConnectionError, RuntimeError):
+                        pass
+                    writer.close()
+                    return
+                self._handles[token] = h
+            h.writer = writer
+            # ship anything committed before this follower connected
+            # (normally nothing: attach requires zxid == 0)
+            self._push_commits()
+            try:
+                # the follower acks mirrored indices on this channel;
+                # acks are what advance the truncation floor
+                while True:
+                    msg = await _read_msg(reader)
+                    if msg[0] == 'ack':
+                        h.applied = max(h.applied, msg[1])
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass                         # EOF = follower died
+            finally:
+                self._detach(h)
+        elif kind == 'control':
+            await self._serve_control(reader, writer)
+        else:  # pragma: no cover - only this module speaks the protocol
+            writer.close()
+
+    def _detach(self, h: _FollowerHandle) -> None:
+        self._handles.pop(h.token, None)
+        if h in self.db._replicas:
+            self.db._replicas.remove(h)
+        if h.writer is not None:
+            h.writer.close()
+            h.writer = None
+        log.info('follower %s detached', h.token)
+
+    async def _serve_control(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        db = self.db
+        try:
+            while True:
+                msg = await _read_msg(reader)
+                op = msg[0]
+                if op == 'touch':
+                    sess = db.sessions.get(msg[1])
+                    if sess is not None and not sess.expired \
+                            and not sess.closed:
+                        db.touch_session(sess)
+                    continue
+                assert op == 'rpc', op
+                _, seq, method, args, have = msg
+                status, payload = self._dispatch(method, args)
+                base, entries = self._entries_from(have)
+                writer.write(_dump(
+                    ('res', seq, status, payload, base, entries)))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _dispatch(self, method: str, args: tuple):
+        db = self.db
+        try:
+            if method == 'create':
+                path, data, acl, flags, sid = args
+                return 'ok', db.create(path, data, acl,
+                                       CreateFlag(flags),
+                                       db.sessions.get(sid))
+            if method == 'delete':
+                db.delete(*args)
+                return 'ok', None
+            if method == 'set_data':
+                return 'ok', db.set_data(*args)
+            if method == 'create_session':
+                sess = db.create_session(args[0])
+                return 'ok', (sess.id, sess.passwd, sess.timeout)
+            if method == 'resume_session':
+                sess = db.resume_session(*args)
+                if sess is None:
+                    return 'ok', None
+                return 'ok', (sess.id, sess.passwd, sess.timeout)
+            if method == 'close_session':
+                db.close_session(args[0])
+                return 'ok', None
+            if method == 'sync_barrier':
+                return 'ok', None    # the piggybacked entries ARE the
+                                     # barrier: up through db.log_end()
+            return 'exc', 'unknown rpc %r' % (method,)
+        except ZKOpError as e:
+            return 'err', e.code
+        except Exception as e:  # pragma: no cover - leader-side bug
+            log.exception('rpc %s failed', method)
+            return 'exc', repr(e)
+
+
+class RemoteLeader(EventEmitter):
+    """Follower-process side: the ``db``-shaped object a ``ZKServer``
+    forwards leader operations through, plus the commit-log mirror its
+    :class:`RemoteReplicaStore` replays.
+
+    Emits ``committed`` (mirror grew) and ``sessionExpired(sid)`` —
+    the two ``ZKDatabase`` events the server stack subscribes to."""
+
+    def __init__(self, host: str, port: int):
+        super().__init__()
+        self.host = host
+        self.port = port
+        import uuid
+        self._token = uuid.uuid4().hex
+        #: the commit-log mirror (never truncated: one local replica)
+        self.log: list = []
+        self.log_base = 0
+        self.sessions: dict[int, ZKServerSession] = {}
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._events_task: asyncio.Task | None = None
+        #: kept referenced: a dropped StreamWriter closes its transport
+        #: and the leader would see EOF and detach this follower
+        self._events_writer: asyncio.StreamWriter | None = None
+
+    # -- ReplicaStore's leader surface --
+
+    def log_end(self) -> int:
+        return self.log_base + len(self.log)
+
+    def attach_replica(self, replica) -> None:
+        assert self.log_end() == 0, \
+            'replica attached after mirrored history began'
+
+    async def connect(self) -> 'RemoteLeader':
+        self._sock = socket.create_connection((self.host, self.port))
+        self._sock.sendall(_dump(('control', self._token)))
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port)
+        writer.write(_dump(('events', self._token)))
+        await writer.drain()
+        self._events_writer = writer
+        self._events_task = asyncio.get_running_loop().create_task(
+            self._consume_events(reader))
+        return self
+
+    def close(self) -> None:
+        if self._events_task is not None:
+            self._events_task.cancel()
+            self._events_task = None
+        if self._events_writer is not None:
+            self._events_writer.close()
+            self._events_writer = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    async def _consume_events(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                msg = await _read_msg(reader)
+                if msg[0] == 'commit':
+                    self._ingest(msg[1], msg[2])
+                    self.emit('committed')
+                elif msg[0] == 'session_expired':
+                    sess = self.sessions.get(msg[1])
+                    if sess is not None:
+                        sess.expired = True
+                    self.emit('sessionExpired', msg[1])
+                elif msg[0] == 'reject':
+                    log.error('leader rejected this follower: %s',
+                              msg[1])
+                    self.close()
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+
+    def _ingest(self, base: int, entries: list) -> None:
+        """Merge a batch of log entries starting at absolute index
+        ``base`` into the mirror (entries can arrive on both channels;
+        overlap is dropped, gaps are impossible on ordered sockets from
+        one leader loop).  Growth is acked to the leader — acks, not
+        shipments, advance its truncation floor, so the control
+        channel's piggyback can always serve from this mirror's end."""
+        end = self.log_end()
+        assert base <= end, (base, end)
+        tail = entries[end - base:]
+        if tail:
+            self.log.extend(tail)
+            if self._events_writer is not None:
+                try:
+                    self._events_writer.write(
+                        _dump(('ack', self.log_end())))
+                except (ConnectionError, RuntimeError):
+                    pass
+
+    # -- control-channel RPC --
+
+    def _rpc(self, method: str, *args):
+        with self._lock:
+            assert self._sock is not None, 'RemoteLeader not connected'
+            self._seq += 1
+            seq = self._seq
+            self._sock.sendall(_dump(
+                ('rpc', seq, method, args, self.log_end())))
+            res = _recv_msg(self._sock)
+        tag, rseq, status, payload, base, entries = res
+        assert tag == 'res' and rseq == seq, res
+        self._ingest(base, entries)
+        if entries:
+            self.emit('committed')
+        if status == 'err':
+            raise ZKOpError(payload)
+        if status == 'exc':
+            raise RuntimeError('leader rpc failed: %s' % (payload,))
+        return payload
+
+    # -- the ZKDatabase surface ServerConnection uses --
+
+    def create(self, path, data, acl, flags, session=None):
+        sid = session.id if session is not None else 0
+        return self._rpc('create', path, data, acl, int(flags), sid)
+
+    def delete(self, path, version):
+        return self._rpc('delete', path, version)
+
+    def set_data(self, path, data, version):
+        return self._rpc('set_data', path, data, version)
+
+    def sync_barrier(self) -> None:
+        """Round-trip to the leader; on return the mirror holds every
+        transaction the leader had committed when the RPC arrived."""
+        self._rpc('sync_barrier')
+
+    def _session(self, sid: int, passwd: bytes,
+                 timeout: int) -> ZKServerSession:
+        sess = self.sessions.get(sid)
+        if sess is None:
+            sess = self.sessions[sid] = ZKServerSession(
+                id=sid, passwd=passwd, timeout=timeout)
+        return sess
+
+    def create_session(self, timeout: int) -> ZKServerSession:
+        sid, passwd, timeout = self._rpc('create_session', timeout)
+        return self._session(sid, passwd, timeout)
+
+    def resume_session(self, session_id: int,
+                       passwd: bytes) -> ZKServerSession | None:
+        res = self._rpc('resume_session', session_id, passwd)
+        if res is None:
+            return None
+        return self._session(*res)
+
+    def touch_session(self, sess: ZKServerSession) -> None:
+        # fire-and-forget: expiry timers live in the leader process
+        with self._lock:
+            if self._sock is not None:
+                self._sock.sendall(_dump(('touch', sess.id)))
+
+    def close_session(self, session_id: int) -> None:
+        self._rpc('close_session', session_id)
+        sess = self.sessions.get(session_id)
+        if sess is not None:
+            sess.closed = True
+
+
+class RemoteReplicaStore(ReplicaStore):
+    """A follower's replica over a :class:`RemoteLeader` mirror.  The
+    only semantic difference from the in-process replica is the SYNC
+    op: its barrier must first *fetch* — everything the leader has
+    committed is the sync point, not everything the mirror happens to
+    hold.  Plain ``catch_up`` (the read-your-own-write step after a
+    forwarded write) stays local: the write RPC's piggyback already
+    delivered the mirror through the write, and a second blocking
+    round-trip per write would stall the member's whole event loop."""
+
+    def sync_flush(self) -> None:
+        self.leader.sync_barrier()
+        self.catch_up()

@@ -301,8 +301,9 @@ class ServerConnection:
         # has committed before replying, so a read issued after the
         # sync reply cannot see state older than the sync point —
         # the guarantee the reference test relies on
-        # (multi-node.test.js:107-165).
-        self.store.catch_up()
+        # (multi-node.test.js:107-165).  sync_flush, not catch_up: a
+        # cross-process member must fetch the leader's log first.
+        self.store.sync_flush()
         self._reply(pkt['xid'], 'SYNC')
 
     def _op_close_session(self, pkt: dict) -> None:

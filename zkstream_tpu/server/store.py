@@ -230,6 +230,9 @@ class ZKDatabase(NodeTree):
     def catch_up(self) -> None:
         """The leader is always caught up (uniform member interface)."""
 
+    def sync_flush(self) -> None:
+        """The SYNC op's barrier — trivial on the leader."""
+
     #: Truncate the applied-everywhere log prefix in chunks (a del of
     #: a list prefix is O(surviving entries) — amortize it).
     LOG_TRUNC_CHUNK = 256
@@ -455,7 +458,13 @@ class ReplicaStore(NodeTree):
             raise AssertionError('unknown log entry %r' % (op,))
 
     def catch_up(self) -> None:
-        """Apply everything committed so far — the ``sync`` op's
-        flush, and what a write through this member does so its
-        author can read their own write."""
+        """Apply everything committed so far — what a write through
+        this member does so its author can read their own write."""
         self._apply_until(self.leader.log_end())
+
+    def sync_flush(self) -> None:
+        """The ``sync`` op's barrier: for an in-process replica the
+        leader's log IS the committed history, so this is
+        ``catch_up``; the cross-process replica overrides it to fetch
+        first (server/replication.py)."""
+        self.catch_up()
