@@ -520,6 +520,22 @@ CONNECT_RESPONSE_EXPIRED = (
     b'\x00\x00\x00\x10' + b'\x00' * 16
 )
 
+CONNECT_REQUEST_FRESH = (
+    # first-ever connect: no session to resume — zero lastZxid, zero
+    # sessionId, and an EMPTY passwd, which jute puts on the wire as
+    # length -1 (reference: lib/jute-buffer.js:127-130)
+    b'\x00\x00\x00\x00'                   # protocolVersion = 0
+    b'\x00\x00\x00\x00\x00\x00\x00\x00'   # lastZxidSeen = 0
+    b'\x00\x00\x75\x30'                   # timeOut = 30000
+    b'\x00\x00\x00\x00\x00\x00\x00\x00'   # sessionId = 0
+    b'\xff\xff\xff\xff'                   # passwd: empty => -1
+)
+
+CONNECT_REQUEST_FRESH_PKT = {
+    'protocolVersion': 0, 'lastZxidSeen': 0, 'timeOut': 30000,
+    'sessionId': 0, 'passwd': b'',
+}
+
 
 def test_connect_request_decode_and_reencode():
     r = JuteReader(CONNECT_REQUEST_RESUME)
@@ -545,23 +561,46 @@ def test_connect_response_decode_and_reencode():
     assert got['sessionId'] == 0 and got['passwd'] == b'\x00' * 16
 
 
+def test_connect_request_fresh_decode_and_reencode():
+    """The fresh (sessionId = 0) handshake vector, with the empty
+    passwd's -1 wire length — the other half of the resume/fresh
+    matrix the reference exercises on every first connect
+    (lib/zk-session.js:198-204 sends the stored creds, zero/empty on a
+    brand-new session)."""
+    r = JuteReader(CONNECT_REQUEST_FRESH)
+    got = records.read_connect_request(r)
+    assert r.at_end()
+    assert got == CONNECT_REQUEST_FRESH_PKT
+    w = JuteWriter()
+    records.write_connect_request(w, dict(got))
+    assert w.to_bytes() == CONNECT_REQUEST_FRESH
+
+
 @pytest.mark.parametrize('ro_byte', [b'', b'\x00', b'\x01'],
                          ids=['absent', 'readonly-0', 'readonly-1'])
-def test_connect_handshake_readonly_byte_tolerated(ro_byte):
+@pytest.mark.parametrize('req_wire,req_pkt,resp_wire,resp_sid', [
+    (CONNECT_REQUEST_RESUME, CONNECT_REQUEST_RESUME_PKT,
+     CONNECT_RESPONSE, 0x1FAF000000000001),
+    (CONNECT_REQUEST_FRESH, CONNECT_REQUEST_FRESH_PKT,
+     CONNECT_RESPONSE_EXPIRED, 0),
+], ids=['resume', 'fresh'])
+def test_connect_handshake_readonly_byte_tolerated(
+        ro_byte, req_wire, req_pkt, resp_wire, resp_sid):
     """ZooKeeper 3.4+ appends a readOnly bool to both handshake
     messages; 3.3 omits it.  The reference reads only the four fixed
     fields and ignores any trailing byte (lib/zk-buffer.js:22-56 reads
     exactly four fields; the decode stream discards the remainder) —
-    the full receive path here must accept all three framings."""
+    the full receive path here must accept every cell of the
+    cross-version x resume/fresh matrix."""
     from zkstream_tpu.protocol.framing import PacketCodec, frame
 
     client = PacketCodec()                 # decoding a ConnectResponse
-    pkts = client.decode(frame(CONNECT_RESPONSE + ro_byte))
-    assert pkts == [CONNECT_RESPONSE_PKT]
+    pkts = client.decode(frame(resp_wire + ro_byte))
+    assert len(pkts) == 1 and pkts[0]['sessionId'] == resp_sid
 
     server = PacketCodec(server=True)      # decoding a ConnectRequest
-    pkts = server.decode(frame(CONNECT_REQUEST_RESUME + ro_byte))
-    assert pkts == [CONNECT_REQUEST_RESUME_PKT]
+    pkts = server.decode(frame(req_wire + ro_byte))
+    assert pkts == [req_pkt]
 
 
 def test_fixture_corpus_covers_every_opcode_both_directions():

@@ -186,6 +186,82 @@ async def run_cell(mode: str, n_conns: int) -> dict:
     return out
 
 
+def _sign_test_p(wins: int, losses: int) -> float:
+    """Two-sided exact sign test (ties dropped): the probability of a
+    split at least this lopsided under H0 = deltas symmetric around 0."""
+    import math
+
+    n = wins + losses
+    if n == 0:
+        return 1.0
+    k = min(wins, losses)
+    p = 2.0 * sum(math.comb(n, i) for i in range(k + 1)) / (2.0 ** n)
+    return min(1.0, p)
+
+
+def run_paired(mode_a: str, mode_b: str, conns: list[int],
+               rounds: int) -> None:
+    """Paired comparison (VERDICT r4 next #5): run the two modes
+    back-to-back within each round — adjacent in time, same host
+    conditions — and judge each fleet size on the per-round SIGN of
+    the delta rather than best-of-N point estimates, which the r3/r4
+    sweeps showed swing +-30-50%% on this one shared core.  Emits one
+    summary JSON per fleet size: win counts, every paired delta, the
+    exact sign-test p-value, and the dispatch-policy routing fractions
+    (how often the guard/threshold actually sent ticks to the scalar
+    drain)."""
+    deltas: dict[int, list[float]] = {n: [] for n in conns}
+    routing: dict[int, dict] = {}
+    for rnd in range(rounds):
+        for n in conns:
+            cell = {}
+            for mode in (mode_a, mode_b):
+                t0 = time.time()
+                try:
+                    r = asyncio.run(run_cell(mode, n))
+                except Exception as e:
+                    r = {'mode': mode, 'conns': n, 'error': repr(e)}
+                r['cell_s'] = round(time.time() - t0, 1)
+                r['round'] = rnd
+                print('#', json.dumps(r), flush=True)
+                cell[mode] = r
+            a, b = cell[mode_a], cell[mode_b]
+            if 'error' in a or 'error' in b:
+                continue
+            ops_a = a['get']['ops_per_sec']
+            ops_b = b['get']['ops_per_sec']
+            if ops_b <= 0 or ops_a <= 0:   # a silently idle cell must
+                continue                   # skip its pair, not void
+                                           # the whole sweep
+            deltas[n].append((ops_a - ops_b) / ops_b * 100.0)
+            if 'ingest' in a:
+                ing = a['ingest']
+                total = max(1, ing['ticks'] + ing['scalar_ticks']
+                            + ing['warming_ticks'] + ing['frag_ticks'])
+                routing[n] = {
+                    'device_frac': round(ing['ticks'] / total, 3),
+                    'scalar_frac': round(
+                        ing['scalar_ticks'] / total, 3),
+                    'frag_frac': round(ing['frag_ticks'] / total, 3),
+                    'frames_per_tick': ing['frames_per_tick']}
+    for n in conns:
+        ds = deltas[n]
+        wins = sum(1 for d in ds if d > 0)
+        losses = sum(1 for d in ds if d < 0)
+        mean = sum(ds) / len(ds) if ds else 0.0
+        print(json.dumps({
+            'paired': '%s-vs-%s' % (mode_a, mode_b),
+            'conns': n,
+            'pairs': len(ds),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(mean, 2),
+            'deltas_pct': [round(d, 2) for d in ds],
+            'sign_p': round(_sign_test_p(wins, losses), 4),
+            'routing': routing.get(n),
+        }), flush=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--conns', default='32,64,128,256,512')
@@ -195,10 +271,18 @@ def main() -> None:
                     help='interleaved rounds per cell; best get-ops '
                          'round is reported (single-core scheduling '
                          'noise swings single runs +-30%%)')
+    ap.add_argument('--paired', default=None, metavar='A,B',
+                    help='paired-design comparison of exactly two '
+                         'modes (e.g. ingest-auto,native): per-round '
+                         'deltas + exact sign test per fleet size')
     args = ap.parse_args()
     global MAX_FRAMES
     MAX_FRAMES = args.max_frames
     conns = [int(x) for x in args.conns.split(',')]
+    if args.paired:
+        mode_a, mode_b = args.paired.split(',')
+        run_paired(mode_a, mode_b, conns, args.rounds)
+        return
     modes = args.modes.split(',')
     best: dict = {}
     for rnd in range(args.rounds):

@@ -111,6 +111,11 @@ class ReplicationService:
         self.port = port
         self._server: asyncio.base_events.Server | None = None
         self._handles: dict[str, _FollowerHandle] = {}
+        #: every open follower transport, severed on stop(): since
+        #: Python 3.12.1 wait_closed() also waits for client handlers,
+        #: which would otherwise loop forever on live channels (the
+        #: same hazard ZKServer.stop() sorts around)
+        self._writers: set[asyncio.StreamWriter] = set()
         self._subscribed = False
 
     async def start(self) -> 'ReplicationService':
@@ -127,6 +132,11 @@ class ReplicationService:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except (ConnectionError, RuntimeError):
+                    pass
             await self._server.wait_closed()
             self._server = None
 
@@ -160,6 +170,14 @@ class ReplicationService:
 
     async def _on_follower(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
+        try:
+            await self._serve_follower(reader, writer)
+        finally:
+            self._writers.discard(writer)
+
+    async def _serve_follower(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
         try:
             hello = await _read_msg(reader)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -186,6 +204,10 @@ class ReplicationService:
                     return
                 self._handles[token] = h
             h.writer = writer
+            # the follower's connect() blocks until this lands: a
+            # commit racing the hello would otherwise slip between
+            # "connected" and "attached" and never be logged
+            self._push(h, ('attached',))
             # ship anything committed before this follower connected
             # (normally nothing: attach requires zxid == 0)
             self._push_commits()
@@ -293,6 +315,13 @@ class RemoteLeader(EventEmitter):
         self.sessions: dict[int, ZKServerSession] = {}
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
+        #: serializes mirror growth: in the follower process both
+        #: channels run on one event loop, but test harnesses (and any
+        #: future off-loop caller) may drive the blocking control
+        #: channel from another thread, and a racy double-append would
+        #: shift every later batch's slice indices
+        self._mirror_lock = threading.Lock()
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._seq = 0
         self._events_task: asyncio.Task | None = None
         #: kept referenced: a dropped StreamWriter closes its transport
@@ -305,10 +334,14 @@ class RemoteLeader(EventEmitter):
         return self.log_base + len(self.log)
 
     def attach_replica(self, replica) -> None:
-        assert self.log_end() == 0, \
-            'replica attached after mirrored history began'
+        # Any time is fine here, unlike ZKDatabase.attach_replica: the
+        # mirror is never truncated, so a replica starting at applied=0
+        # can always replay the full history — even if a commit raced
+        # in between the leader's attach confirmation and this call.
+        assert self.log_base == 0, 'mirror must hold history from 0'
 
     async def connect(self) -> 'RemoteLeader':
+        self._loop = asyncio.get_running_loop()
         self._sock = socket.create_connection((self.host, self.port))
         self._sock.sendall(_dump(('control', self._token)))
         reader, writer = await asyncio.open_connection(
@@ -316,8 +349,16 @@ class RemoteLeader(EventEmitter):
         writer.write(_dump(('events', self._token)))
         await writer.drain()
         self._events_writer = writer
+        self._attached = asyncio.get_running_loop().create_future()
         self._events_task = asyncio.get_running_loop().create_task(
             self._consume_events(reader))
+        # barrier: until the leader confirms the attach, a commit
+        # could race this follower into the late-joiner reject
+        try:
+            await asyncio.wait_for(self._attached, timeout=10)
+        except BaseException:
+            self.close()
+            raise
         return self
 
     def close(self) -> None:
@@ -343,9 +384,17 @@ class RemoteLeader(EventEmitter):
                     if sess is not None:
                         sess.expired = True
                     self.emit('sessionExpired', msg[1])
+                elif msg[0] == 'attached':
+                    if not self._attached.done():
+                        self._attached.set_result(True)
                 elif msg[0] == 'reject':
                     log.error('leader rejected this follower: %s',
                               msg[1])
+                    if not self._attached.done():
+                        self._attached.set_exception(
+                            ConnectionError(
+                                'leader rejected this follower: %s'
+                                % (msg[1],)))
                     self.close()
                     return
         except (asyncio.IncompleteReadError, ConnectionError,
@@ -355,21 +404,40 @@ class RemoteLeader(EventEmitter):
     def _ingest(self, base: int, entries: list) -> None:
         """Merge a batch of log entries starting at absolute index
         ``base`` into the mirror (entries can arrive on both channels;
-        overlap is dropped, gaps are impossible on ordered sockets from
-        one leader loop).  Growth is acked to the leader — acks, not
-        shipments, advance its truncation floor, so the control
-        channel's piggyback can always serve from this mirror's end."""
-        end = self.log_end()
-        assert base <= end, (base, end)
-        tail = entries[end - base:]
-        if tail:
-            self.log.extend(tail)
-            if self._events_writer is not None:
+        overlap is dropped under the mirror lock, gaps are impossible
+        on ordered sockets from one leader loop).  Growth is acked to
+        the leader — acks, not shipments, advance its truncation
+        floor, so the control channel's piggyback can always serve
+        from this mirror's end."""
+        with self._mirror_lock:
+            end = self.log_end()
+            assert base <= end, (base, end)
+            tail = entries[end - base:]
+            if tail:
+                self.log.extend(tail)
+            acked = self.log_end()
+        if tail and self._events_writer is not None:
+            # the ack rides the events transport, which belongs to the
+            # loop: schedule the write there when called off-loop
+            data = _dump(('ack', acked))
+
+            def send():
                 try:
-                    self._events_writer.write(
-                        _dump(('ack', self.log_end())))
-                except (ConnectionError, RuntimeError):
-                    pass
+                    self._events_writer.write(data)
+                except (AttributeError, ConnectionError, RuntimeError):
+                    pass                  # closed mid-shutdown
+            try:
+                on_loop = asyncio.get_running_loop() is self._loop
+            except RuntimeError:
+                on_loop = False           # no loop on this thread
+            if on_loop:
+                send()
+            elif self._loop is not None:
+                try:
+                    self._loop.call_soon_threadsafe(send)
+                except RuntimeError:
+                    pass                  # loop closed
+
 
     # -- control-channel RPC --
 
