@@ -171,3 +171,44 @@ async def test_process_members_replicate_and_sync(process_ensemble):
     finally:
         await c1.close()
         await c2.close()
+
+
+async def test_killed_follower_replaced_by_fresh_process(
+        process_ensemble):
+    """The restart half of the reference experiment
+    (multi-node.test.js restarts a killed server): after SIGKILLing a
+    follower, a replacement follower process joins the live ensemble
+    late — bootstrapped from the leader's snapshot — and serves the
+    full tree to clients."""
+    leader, (f1, f2) = process_ensemble
+    c = _client([('127.0.0.1', f1.ports[0])])
+    try:
+        await c.wait_connected(timeout=10)
+        for i in range(5):
+            await c.create('/pre%d' % i, b'v%d' % i)
+    finally:
+        await c.close()
+
+    os.kill(f1.proc.pid, signal.SIGKILL)
+    f1.proc.wait()
+
+    # a replacement member, joining AFTER history began
+    f3 = _spawn('follower', '127.0.0.1', str(leader.ports[1]))
+    try:
+        c3 = _client([('127.0.0.1', f3.ports[0])])
+        try:
+            await c3.wait_connected(timeout=10)
+            await c3.sync('/pre0')
+            for i in range(5):
+                data, _ = await c3.get('/pre%d' % i)
+                assert data == b'v%d' % i
+            # and it serves writes + watches like any member
+            await c3.create('/via3', b'x')
+            data, _ = await c3.get('/via3')
+            assert data == b'x'
+        finally:
+            await c3.close()
+    finally:
+        f3.proc.kill()
+        f3.proc.wait()
+        f3.proc.stdout.close()

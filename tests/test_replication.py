@@ -2,8 +2,8 @@
 (tests/test_process_ensemble.py proves the tier end-to-end across real
 OS processes; these drive the same code in ONE process so the error
 paths and bookkeeping are observable: RPC error propagation, mirror
-ingest/ack flow, truncation interplay, late-joiner rejection, detach
-on follower death).
+ingest/ack flow, truncation interplay, late-joiner snapshot
+bootstrap, detach on follower death).
 
 The control channel is a blocking socket by design (follower request
 handlers call it inline); here the blocking calls run on an executor
@@ -131,18 +131,62 @@ async def test_expiry_broadcast_reaches_follower(repl):
     assert remote.sessions[sess.id].expired
 
 
-async def test_late_joiner_is_rejected_loudly(repl):
+async def test_late_joiner_bootstraps_from_snapshot(repl):
+    """A follower joining after history began installs the leader's
+    snapshot and replays only the tail — real ZK's follower resync.
+    History before ANY replica attached was never logged; the image
+    carries its effects anyway."""
+    db, svc, connect = repl
+    # pre-replication history: zxid advances, nothing is logged
+    db.create('/pre', b'old', OPEN_ACL_UNSAFE, CreateFlag(0))
+    assert db.zxid == 1 and db.log_end() == 0
+
+    late = await connect()
+    store = RemoteReplicaStore(late, lag=0.0)
+    assert store.nodes['/pre'].data == b'old'
+    assert store.zxid == 1 and store.applied == 0
+
+    # post-join traffic replicates normally, via both channels
+    await _rpc(late.create, '/post', b'new', OPEN_ACL_UNSAFE,
+               CreateFlag(0), None)
+    store.catch_up()
+    assert store.nodes['/post'].data == b'new'
+    db.create('/pushed', b'p', OPEN_ACL_UNSAFE, CreateFlag(0))
+    for _ in range(50):
+        if late.log_end() == db.log_end():
+            break
+        await asyncio.sleep(0.02)
+    store.catch_up()
+    assert store.nodes['/pushed'].data == b'p'
+    assert store.zxid == db.zxid == 3
+
+
+async def test_snapshot_join_past_truncated_log(repl):
+    """A joiner arriving after the log was truncated (its prefix
+    applied everywhere and dropped) still bootstraps correctly: the
+    snapshot position sits past the truncation floor by
+    construction."""
     db, svc, connect = repl
     first = await connect()
-    await _rpc(first.create, '/early', b'', OPEN_ACL_UNSAFE,
+    RemoteReplicaStore(first, lag=0.0)
+    n = ZKDatabase.LOG_TRUNC_CHUNK + 20
+    for i in range(n):
+        await _rpc(first.create, '/n%d' % i, b'', OPEN_ACL_UNSAFE,
+                   CreateFlag(0), None)
+    (h1,) = svc._handles.values()
+    for _ in range(100):
+        if h1.applied == db.log_end():
+            break
+        await asyncio.sleep(0.02)
+    await _rpc(first.create, '/trunc-trigger', b'', OPEN_ACL_UNSAFE,
                CreateFlag(0), None)
-    assert db.zxid > 0
-    # history began: connect() must FAIL (reject on the events
-    # channel), not hand back a follower wedged on an empty tree
-    with pytest.raises(ConnectionError, match='rejected'):
-        await connect()
-    # the healthy follower is unaffected
-    assert len(db._replicas) == 1
+    assert db.log_base > 0, 'truncation never ran'
+
+    late = await connect()
+    store = RemoteReplicaStore(late, lag=0.0)
+    await _rpc(store.sync_flush)
+    assert store.nodes.keys() == db.nodes.keys()
+    assert store.zxid == db.zxid
 
 
 async def test_follower_death_detaches_handle(repl):

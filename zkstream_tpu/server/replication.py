@@ -31,10 +31,12 @@ for the same reason the reference can shell out to a local JVM: both
 ends are the same trusted test harness on one machine; this service
 must never listen on a non-loopback interface.
 
-Limitations (documented, deliberate): the leader process is the quorum
-— killing it kills the ensemble (no election); a SIGKILLed follower
-stays dead (re-attach of a non-empty replica is not supported by the
-attach-before-first-transaction invariant, store.py).
+Follower restart is supported the way real ZK does it: a follower
+joining after history began (or rejoining after a SIGKILL) is
+bootstrapped from a leader snapshot — the tree image plus its log
+position — and replays only the tail from there.  The one deliberate
+limitation: the leader process is the quorum — killing it kills the
+ensemble (no election).
 """
 
 from __future__ import annotations
@@ -188,28 +190,30 @@ class ReplicationService:
             h = self._handles.get(token)
             if h is None:
                 h = _FollowerHandle(token)
+                h.writer = writer
                 try:
                     self.db.attach_replica(h)
-                except ValueError as e:
-                    # a late joiner (e.g. a restarted follower after
-                    # history began) is REJECTED loudly, not wedged
-                    # silently on an empty tree
-                    log.error('rejecting follower %s: %s', token, e)
-                    try:
-                        writer.write(_dump(('reject', str(e))))
-                        await writer.drain()
-                    except (ConnectionError, RuntimeError):
-                        pass
-                    writer.close()
-                    return
+                except ValueError:
+                    # a late joiner (a follower restarted — or first
+                    # started — after history began): bootstrap it
+                    # from a snapshot, real ZK's follower resync.  The
+                    # log before replication began was never retained;
+                    # the tree image carries its effects.
+                    pos = self.db.attach_replica_at_tail(h)
+                    h.applied = h.shipped = pos
+                    self._push(h, ('snapshot', self.db.snapshot(),
+                                   pos))
+                    log.info('follower %s joined late: snapshot at '
+                             'log index %d (zxid %d)', token, pos,
+                             self.db.zxid)
                 self._handles[token] = h
-            h.writer = writer
+            else:
+                h.writer = writer
             # the follower's connect() blocks until this lands: a
             # commit racing the hello would otherwise slip between
             # "connected" and "attached" and never be logged
             self._push(h, ('attached',))
             # ship anything committed before this follower connected
-            # (normally nothing: attach requires zxid == 0)
             self._push_commits()
             try:
                 # the follower acks mirrored indices on this channel;
@@ -313,6 +317,10 @@ class RemoteLeader(EventEmitter):
         self.log: list = []
         self.log_base = 0
         self.sessions: dict[int, ZKServerSession] = {}
+        #: set when the leader bootstrapped this (late-joining)
+        #: follower from a snapshot: (image, absolute log index) that
+        #: RemoteReplicaStore installs before replaying the tail
+        self._snapshot: tuple[dict, int] | None = None
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         #: serializes mirror growth: in the follower process both
@@ -334,11 +342,11 @@ class RemoteLeader(EventEmitter):
         return self.log_base + len(self.log)
 
     def attach_replica(self, replica) -> None:
-        # Any time is fine here, unlike ZKDatabase.attach_replica: the
-        # mirror is never truncated, so a replica starting at applied=0
-        # can always replay the full history — even if a commit raced
-        # in between the leader's attach confirmation and this call.
-        assert self.log_base == 0, 'mirror must hold history from 0'
+        # Any time is fine here, unlike ZKDatabase.attach_replica: a
+        # replica either replays the never-truncated mirror from 0 or
+        # installs the leader's snapshot and starts at log_base
+        # (RemoteReplicaStore.__init__ picks per self._snapshot).
+        pass
 
     async def connect(self) -> 'RemoteLeader':
         self._loop = asyncio.get_running_loop()
@@ -352,8 +360,9 @@ class RemoteLeader(EventEmitter):
         self._attached = asyncio.get_running_loop().create_future()
         self._events_task = asyncio.get_running_loop().create_task(
             self._consume_events(reader))
-        # barrier: until the leader confirms the attach, a commit
-        # could race this follower into the late-joiner reject
+        # barrier: until the leader confirms the attach (snapshot
+        # included for a late joiner), a commit could race this
+        # follower into a silent gap before its handle exists
         try:
             await asyncio.wait_for(self._attached, timeout=10)
         except BaseException:
@@ -384,19 +393,16 @@ class RemoteLeader(EventEmitter):
                     if sess is not None:
                         sess.expired = True
                     self.emit('sessionExpired', msg[1])
+                elif msg[0] == 'snapshot':
+                    # always precedes 'attached' on this ordered
+                    # socket; the mirror starts at the image's index
+                    with self._mirror_lock:
+                        assert not self.log, 'snapshot after entries'
+                        self._snapshot = (msg[1], msg[2])
+                        self.log_base = msg[2]
                 elif msg[0] == 'attached':
                     if not self._attached.done():
                         self._attached.set_result(True)
-                elif msg[0] == 'reject':
-                    log.error('leader rejected this follower: %s',
-                              msg[1])
-                    if not self._attached.done():
-                        self._attached.set_exception(
-                            ConnectionError(
-                                'leader rejected this follower: %s'
-                                % (msg[1],)))
-                    self.close()
-                    return
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
@@ -510,14 +516,33 @@ class RemoteLeader(EventEmitter):
 
 
 class RemoteReplicaStore(ReplicaStore):
-    """A follower's replica over a :class:`RemoteLeader` mirror.  The
-    only semantic difference from the in-process replica is the SYNC
-    op: its barrier must first *fetch* — everything the leader has
-    committed is the sync point, not everything the mirror happens to
-    hold.  Plain ``catch_up`` (the read-your-own-write step after a
-    forwarded write) stays local: the write RPC's piggyback already
-    delivered the mirror through the write, and a second blocking
-    round-trip per write would stall the member's whole event loop."""
+    """A follower's replica over a :class:`RemoteLeader` mirror.  Two
+    semantic differences from the in-process replica:
+
+    - a late joiner installs the leader's snapshot and replays only
+      the tail (the mirror's ``log_base`` is the image's index);
+    - the SYNC op's barrier must first *fetch* — everything the
+      leader has committed is the sync point, not everything the
+      mirror happens to hold.  Plain ``catch_up`` (the
+      read-your-own-write step after a forwarded write) stays local:
+      the write RPC's piggyback already delivered the mirror through
+      the write, and a second blocking round-trip per write would
+      stall the member's whole event loop."""
+
+    def __init__(self, leader: RemoteLeader, lag: float | None = 0.0):
+        super().__init__(leader, lag=lag)
+        if leader._snapshot is not None:
+            snap, pos = leader._snapshot
+            leader._snapshot = None     # release the image: installed
+            self.install(snap)          # state must not be pinned (or
+            self.applied = pos          # re-installed) afterwards
+        if self.lag is not None and self.lag <= 0:
+            # entries can land in the mirror between the snapshot (or
+            # plain attach) and this construction; _on_commit only
+            # fires on FUTURE pushes, so apply the backlog now or a
+            # lag=0 replica could serve stale reads until the next
+            # unrelated write
+            self.catch_up()
 
     def sync_flush(self) -> None:
         self.leader.sync_barrier()

@@ -123,6 +123,25 @@ class NodeTree(EventEmitter):
         self.nodes: dict[str, Znode] = {'/': Znode()}
         self.zxid = 0
 
+    # -- snapshot (late-joining replica bootstrap) --
+
+    def snapshot(self) -> dict:
+        """An image of the tree and its position — what a late-joining
+        replica installs before replaying the log tail (real ZK's
+        follower resync; server/replication.py).  The image ALIASES the
+        live tree: the one caller pickles it onto the wire in the same
+        synchronous tick, so a defensive deep copy would only duplicate
+        an arbitrarily large tree for nothing.  An in-process consumer
+        that intends to retain it must copy it itself."""
+        return {'zxid': self.zxid, 'nodes': self.nodes}
+
+    def install(self, snap: dict) -> None:
+        """Replace this tree with a snapshot image.  The image is
+        adopted, not copied — it arrives freshly unpickled from the
+        replication socket and is private to this replica."""
+        self.nodes = snap['nodes']
+        self.zxid = snap['zxid']
+
     # -- transaction apply (leader commit path + replica replay) --
 
     def _apply_create(self, path: str, data: bytes, acl: tuple,
@@ -232,6 +251,18 @@ class ZKDatabase(NodeTree):
 
     def sync_flush(self) -> None:
         """The SYNC op's barrier — trivial on the leader."""
+
+    def attach_replica_at_tail(self, replica) -> int:
+        """Attach a replica that is bootstrapped from a snapshot (the
+        cross-process late join, server/replication.py): it needs no
+        history before the current log tail — the tree image carries
+        the effects of everything already committed, including
+        transactions from before replication began that were never
+        logged — so unlike :meth:`attach_replica` it may join at any
+        time.  Returns the absolute log index the snapshot is current
+        through (the joiner's starting ``applied``)."""
+        self._replicas.append(replica)
+        return self.log_end()
 
     #: Truncate the applied-everywhere log prefix in chunks (a del of
     #: a list prefix is O(surviving entries) — amortize it).
