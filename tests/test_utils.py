@@ -76,6 +76,77 @@ class Machine(FSM):
         self.log.append('enter b.inner')
         S.on(self, 'back', lambda: S.goto_state('b'))
 
+def test_emitter_remove_all_listeners_one_event_and_all():
+    e = EventEmitter()
+    seen = []
+    e.on('a', lambda: seen.append('a'))
+    e.on('b', lambda: seen.append('b'))
+    e.remove_all_listeners('a')
+    assert e.emit('a') is False and e.emit('b') is True
+    e.remove_all_listeners()
+    assert e.emit('b') is False
+    assert seen == ['b']
+
+
+def test_emitter_listeners_introspection_is_a_copy():
+    e = EventEmitter()
+
+    def cb():
+        pass
+    e.on('x', cb)
+    got = e.listeners('x')
+    assert got == [cb] and e.listener_count('x') == 1
+    got.clear()                      # mutating the copy changes nothing
+    assert e.listener_count('x') == 1
+    assert e.listeners('nope') == [] and e.listener_count('nope') == 0
+
+
+def test_emitter_remove_unknown_listener_is_noop():
+    e = EventEmitter()
+    e.remove_listener('ghost', lambda: None)    # no such event
+
+    def cb():
+        pass
+
+    def other():
+        pass
+    e.on('x', cb)
+    e.remove_listener('x', other)               # not registered
+    assert e.listener_count('x') == 1
+
+
+def test_emitter_event_cleared_entirely_mid_dispatch():
+    """A listener that removes EVERY listener for the event mid-emit:
+    the dispatch loop sees the registry version change and the event
+    gone, and stops without calling the rest."""
+    e = EventEmitter()
+    seen = []
+
+    def nuke():
+        seen.append('nuke')
+        e.remove_all_listeners('x')
+
+    e.on('x', nuke)
+    e.on('x', lambda: seen.append('late'))
+    assert e.emit('x') is True
+    assert seen == ['nuke']
+
+
+def test_emitter_listener_added_mid_dispatch_not_called_this_emit():
+    e = EventEmitter()
+    seen = []
+
+    def adder():
+        seen.append('adder')
+        e.on('x', lambda: seen.append('new'))
+
+    e.on('x', adder)
+    e.on('x', lambda: seen.append('second'))
+    e.emit('x')
+    assert seen == ['adder', 'second']       # 'new' waits for next emit
+    e.emit('x')
+    assert seen.count('new') == 1
+
 
 def test_fsm_basic_transitions():
     m = Machine()
