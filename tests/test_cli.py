@@ -2,10 +2,14 @@
 against the in-process server (the rebuild's zkCli analogue)."""
 
 import asyncio
+import os
 
 import pytest
 
+from helpers import wait_until
 from zkstream_tpu import Client, cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 async def run_cli(server, *argv, capsys=None):
@@ -165,3 +169,83 @@ async def test_cli_codec_flag(server, capsys):
                                    capsys=capsys)
         assert rc == 0 and out == 'v\n'
     assert cli.build_parser().parse_args(['ping']).codec == 'auto'
+
+
+async def test_cli_stat_flags_on_get_and_ls(server, capsys):
+    rc, _, _ = await run_cli(server, 'create', '/sf', 'data')
+    assert rc == 0
+    capsys.readouterr()
+    rc, out, _ = await run_cli(server, 'get', '--stat', '/sf',
+                               capsys=capsys)
+    assert rc == 0 and 'data' in out and 'dataLength = 4' in out
+    rc, out, _ = await run_cli(server, 'ls', '--stat', '/',
+                               capsys=capsys)
+    assert rc == 0 and 'sf' in out and 'numChildren' in out
+
+
+async def test_cli_create_ephemeral_holds_until_stdin_eof(
+        server, capsys, monkeypatch):
+    """create -e prints the path, announces the hold, and exits when
+    stdin reaches EOF — the ephemeral is alive while held and reaped
+    with the session on exit."""
+    import io
+    import sys as _sys
+
+    import threading
+
+    release = threading.Event()
+
+    class HeldEOF(io.StringIO):
+        def read(self, *a):
+            release.wait(10)         # the test decides when EOF lands
+            return ''
+
+    monkeypatch.setattr(_sys, 'stdin', HeldEOF())
+    task = asyncio.ensure_future(
+        run_cli(server, 'create', '-e', '/held', 'x'))
+    try:
+        # while held: the ephemeral exists, owned by the CLI session
+        # (observed server-side — no second client whose own
+        # connection churn could fake an answer)
+        await wait_until(lambda: '/held' in server.db.nodes)
+        assert server.db.nodes['/held'].ephemeral_owner != 0
+        release.set()                # EOF: the CLI closes its session
+        rc, _, _ = await asyncio.wait_for(task, 10)
+        assert rc == 0
+        out, err = capsys.readouterr()
+        assert out.strip() == '/held'
+        assert 'holding ephemeral until EOF' in err
+        # session closed: the node is reaped
+        await wait_until(lambda: '/held' not in server.db.nodes)
+    finally:
+        release.set()
+
+
+async def test_cli_watch_session_expiry_is_an_error_exit(
+        server, capsys):
+    task = asyncio.ensure_future(run_cli(server, 'watch', '/w'))
+    await wait_until(lambda: bool(server.db.sessions))
+    await asyncio.sleep(0.3)          # watcher armed
+    for sid in list(server.db.sessions):
+        server.db.expire_session(sid)
+    rc, _, _ = await asyncio.wait_for(task, 10)
+    out, err = capsys.readouterr()
+    assert rc == 1 and 'session expired' in err
+
+
+@pytest.mark.timeout(150)
+async def test_cli_main_entry_via_subprocess(server):
+    """python -m zkstream_tpu: the real __main__/main()/argv path,
+    against the fixture server over TCP.  The subprocess runs on an
+    executor thread so this test's loop keeps serving the fixture."""
+    import subprocess
+    import sys as _sys
+
+    out = await asyncio.get_running_loop().run_in_executor(
+        None, lambda: subprocess.run(
+            [_sys.executable, '-m', 'zkstream_tpu',
+             '--server', '127.0.0.1:%d' % server.port,
+             '--session-timeout', '5000', 'ping'],
+            capture_output=True, text=True, timeout=120, cwd=REPO))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert out.stdout.startswith('ping ok:')
