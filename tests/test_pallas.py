@@ -257,3 +257,30 @@ def test_pallas_full_decode_matches_jnp(seed):
             np.asarray(getattr(bd_p, f)), np.asarray(getattr(bd_j, f)),
             err_msg=f'field {f}')
     _assert_same(bd_p.stat_after_data, bd_j.stat_after_data)
+
+
+def test_full_decode_vmem_fallback_is_the_jnp_path():
+    """A shape whose fused kernel would exceed scoped VMEM must fall
+    back to wire_pipeline_step + the jnp GET_DATA unpack — same
+    planes, no compile attempt — exactly as the header kernel's
+    fallback contract (the r4 rewiring this guards)."""
+    from zkstream_tpu.ops.pallas_scan import fits_vmem_full
+    from zkstream_tpu.ops.pipeline import wire_full_decode_pallas
+    from zkstream_tpu.ops.replies import parse_reply_bodies
+
+    rng = random.Random(3)
+    MD = 16
+    B, L = 8, 200_000               # L large: blows the VMEM budget
+    assert not fits_vmem_full(B, L, 6, 8, MD)
+    buf, lens = _getdata_fleet(rng, B, L, MD)
+    st_p, bd_p = wire_full_decode_pallas(
+        buf, lens, max_frames=6, max_data=MD, block_rows=8)
+    st_j = wire_pipeline_step(buf, lens, max_frames=6)
+    _assert_same(st_p, st_j)
+    bd_j = parse_reply_bodies(buf, st_j.starts, st_j.sizes,
+                              max_data=MD, max_path=8)
+    for f in ('data_len', 'data', 'data_mask', 'data_ok'):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(bd_p, f)), np.asarray(getattr(bd_j, f)),
+            err_msg=f'field {f}')
+    _assert_same(bd_p.stat_after_data, bd_j.stat_after_data)
