@@ -491,3 +491,30 @@ async def test_background_warm_thread_is_daemon():
             if t.name == 'ingest-warm']
     assert warm and all(t.daemon for t in warm)
     await asyncio.wait_for(ev.wait(), 60)
+
+
+async def test_close_releases_warm_worker_and_is_idempotent():
+    """close() drains queued compiles FIFO, then the daemon worker
+    exits; a second close is a no-op; an ingest that never warmed has
+    nothing to release."""
+    import threading
+
+    mk_ingest().close()                  # never warmed: no-op
+
+    # other suites' ingests may have parked warm workers of their own;
+    # only the thread THIS ingest starts must exit on close
+    before = {t for t in threading.enumerate()
+              if t.name == 'ingest-warm'}
+    ing = mk_ingest(warm='background')
+    ev = ing._start_warm(ing._bucket(2, ing.min_len))
+    await asyncio.wait_for(ev.wait(), 60)    # queued compile lands
+    (mine,) = [t for t in threading.enumerate()
+               if t.name == 'ingest-warm' and t not in before]
+    ing.close()
+    ing.close()                          # idempotent
+    for _ in range(100):
+        if not mine.is_alive():
+            break
+        await asyncio.sleep(0.05)
+    else:
+        raise AssertionError('warm worker survived close()')
