@@ -122,14 +122,20 @@ def _build_fleet(seed: int, n_streams: int, frames_per_stream: int):
     return buf, lens, maps, pkts
 
 
+@pytest.mark.parametrize('widths', [(MAX_DATA, MAX_PATH),
+                                    (MAX_DATA, MAX_DATA)],
+                         ids=['distinct', 'equal'])
 @pytest.mark.parametrize('seed', [1, 2, 3])
-def test_batched_reply_bodies_match_scalar(seed):
+def test_batched_reply_bodies_match_scalar(seed, widths):
+    # 'equal' exercises the deployed configuration's aliased CREATE
+    # view (max_path == max_data reuses the GET_DATA planes)
+    max_data, max_path = widths
     B, F = 32, 12
     buf, lens, maps, _ = _build_fleet(seed, B, F)
     jbuf, jlens = jnp.asarray(buf), jnp.asarray(lens)
     st = wire_pipeline_step(jbuf, jlens, max_frames=F)
     bodies = parse_reply_bodies(jbuf, st.starts, st.sizes,
-                                max_data=MAX_DATA, max_path=MAX_PATH)
+                                max_data=max_data, max_path=max_path)
     st_np, bd_np = _host(st), _host(bodies)
 
     for b in range(B):
