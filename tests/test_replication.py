@@ -266,3 +266,36 @@ async def test_stop_with_live_followers_does_not_hang(repl):
                CreateFlag(0), None)
     await asyncio.wait_for(svc.stop(), timeout=10)
     assert not svc._handles
+
+
+async def test_unknown_rpc_method_is_a_loud_error(repl):
+    """A protocol-version skew (follower asking for an RPC this leader
+    does not speak) surfaces as a RuntimeError naming the method, not
+    a hang or a silent None."""
+    db, svc, connect = repl
+    remote = await connect()
+    with pytest.raises(RuntimeError, match='nonsense'):
+        await _rpc(remote._rpc, 'nonsense')
+    # the channel survives the error: normal RPCs keep working
+    await _rpc(remote.create, '/after-err', b'', OPEN_ACL_UNSAFE,
+               CreateFlag(0), None)
+
+
+async def test_unknown_hello_kind_is_dropped(repl):
+    """A connection speaking neither channel role is closed, and the
+    service keeps serving real followers."""
+    import struct as _struct
+
+    db, svc, connect = repl
+    reader, writer = await asyncio.open_connection('127.0.0.1',
+                                                   svc.port)
+    import pickle
+    payload = pickle.dumps(('bogus', 'tok'))
+    writer.write(_struct.pack('>I', len(payload)) + payload)
+    await writer.drain()
+    data = await asyncio.wait_for(reader.read(), 5)
+    assert data == b''                   # server closed it
+    writer.close()
+    remote = await connect()             # real followers still join
+    await _rpc(remote.create, '/ok', b'', OPEN_ACL_UNSAFE,
+               CreateFlag(0), None)
