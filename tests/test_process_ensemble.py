@@ -212,3 +212,56 @@ async def test_killed_follower_replaced_by_fresh_process(
         f3.proc.kill()
         f3.proc.wait()
         f3.proc.stdout.close()
+
+
+@pytest.mark.timeout(120)
+async def test_rolling_sigkill_chaos_soak(process_ensemble):
+    """Tier-4 chaos on the process tier: SIGKILL the member serving
+    the session, twice in a row (the client's preference order makes
+    the serving member deterministic: f1, then f2, then the leader
+    member), with replacement followers joining the live ensemble
+    mid-churn via snapshot bootstrap — one client session and its
+    ephemeral live through every generation.  The reference's
+    kill/restart cycling, compressed (multi-node.test.js:309-338)."""
+    from zkstream_tpu.protocol.consts import CreateFlag
+
+    leader, (f1, f2) = process_ensemble
+    spawned: list = []
+    c = _client([('127.0.0.1', f1.ports[0]),
+                 ('127.0.0.1', f2.ports[0]),
+                 ('127.0.0.1', leader.ports[0])],
+                session_timeout=15000)
+    try:
+        await c.wait_connected(timeout=10)
+        sid = c.session.get_session_id()
+        await c.create('/soak-eph', b'alive',
+                       flags=CreateFlag.EPHEMERAL)
+        for gen, victim in enumerate((f1, f2)):
+            # kill the member the session is being served through
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            victim.proc.wait()
+            # ...while a replacement joins the live ensemble
+            nxt = _spawn('follower', '127.0.0.1', str(leader.ports[1]))
+            spawned.append(nxt)
+            st = await _retrying(lambda: c.stat('/soak-eph'),
+                                 attempts=40)
+            assert st is not None
+            assert c.session.get_session_id() == sid, \
+                'session lost at generation %d' % gen
+            await c.set('/soak-eph', b'gen%d' % gen)
+        # the replacements serve the whole churned tree to new clients
+        c2 = _client([('127.0.0.1', spawned[-1].ports[0])])
+        try:
+            await c2.wait_connected(timeout=10)
+            await c2.sync('/soak-eph')
+            data, _ = await c2.get('/soak-eph')
+            assert data == b'gen1'
+        finally:
+            await c2.close()
+    finally:
+        await c.close()
+        for m in spawned:
+            if m.proc.poll() is None:
+                m.proc.kill()
+            m.proc.wait()
+            m.proc.stdout.close()
