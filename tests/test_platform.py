@@ -111,8 +111,9 @@ def test_guard_backend_no_probe_env_short_circuits(monkeypatch):
 
 def test_guard_backend_timeout_falls_back_to_cpu(monkeypatch):
     """The probe hanging (the observed dead-tunnel behavior: device
-    enumeration blocks for 20+ minutes) must kill the probe group and
-    pin THIS process to the CPU backend."""
+    enumeration blocks for 20+ minutes) must kill the probe group,
+    retry ONCE (the tunnel has been observed flaky, not dead), and
+    only then pin THIS process to the CPU backend."""
     import bench
     from zkstream_tpu.utils import platform
 
@@ -125,8 +126,70 @@ def test_guard_backend_timeout_falls_back_to_cpu(monkeypatch):
     monkeypatch.setattr(platform, 'force_cpu',
                         lambda **kw: forced.append(kw))
     bench._guard_backend(timeout_s=0.1)
-    assert len(calls) == 1
+    assert len(calls) == 2    # hang -> one retry -> fallback
     assert forced == [{'n_devices': 1}]
+
+
+def test_guard_backend_flaky_timeout_then_ok_keeps_default(monkeypatch):
+    """A first-attempt hang followed by a healthy retry (the observed
+    flaky-tunnel morning: enumeration hung past 240 s, a fresh probe
+    enumerated in 45 s) must keep the default backend."""
+    import bench
+    from zkstream_tpu.utils import platform
+
+    calls: list = []
+    forced: list = []
+    base = _fake_popen_factory('timeout', calls)
+    ok = _fake_popen_factory('ok', calls)
+
+    def flaky(*a, **kw):
+        return (base if len(calls) == 0 else ok)(*a, **kw)
+
+    monkeypatch.delenv('ZKSTREAM_BENCH_NO_PROBE', raising=False)
+    monkeypatch.setattr(subprocess, 'Popen', flaky)
+    monkeypatch.setattr(os, 'killpg', lambda pid, sig: None)
+    monkeypatch.setattr(platform, 'force_cpu',
+                        lambda **kw: forced.append(kw))
+    bench._guard_backend(timeout_s=0.1)
+    assert len(calls) == 2
+    assert forced == []       # retry succeeded: no fallback
+
+
+def test_guard_backend_probe_timeout_env_resizes_budget(monkeypatch):
+    """ZKSTREAM_BENCH_PROBE_TIMEOUT resizes the per-attempt budget
+    when the caller passes no explicit timeout."""
+    import bench
+    from zkstream_tpu.utils import platform
+
+    budgets: list = []
+
+    class RecordingProc:
+        pid = 99999
+
+        def __init__(self, *a, **kw):
+            pass
+
+        def wait(self, timeout=None):
+            if timeout is not None:
+                budgets.append(timeout)
+                raise subprocess.TimeoutExpired('probe', timeout)
+            return 0
+
+    monkeypatch.setenv('ZKSTREAM_BENCH_PROBE_TIMEOUT', '0.25')
+    monkeypatch.delenv('ZKSTREAM_BENCH_NO_PROBE', raising=False)
+    monkeypatch.setattr(subprocess, 'Popen', RecordingProc)
+    monkeypatch.setattr(os, 'killpg', lambda pid, sig: None)
+    monkeypatch.setattr(platform, 'force_cpu', lambda **kw: None)
+    bench._guard_backend()
+    assert budgets == [0.25, 0.25]
+
+    # malformed / non-positive values fall back to the 240 s default
+    # instead of crashing the guard whose job is a guaranteed headline
+    for bad in ('4m', '-1', '0', 'nan', 'inf'):
+        budgets.clear()
+        monkeypatch.setenv('ZKSTREAM_BENCH_PROBE_TIMEOUT', bad)
+        bench._guard_backend()
+        assert budgets == [240.0, 240.0], (bad, budgets)
 
 
 def test_guard_backend_probe_failure_falls_back_to_cpu(monkeypatch):
