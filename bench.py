@@ -909,14 +909,12 @@ def _guard_backend(timeout_s: float | None = None) -> None:
     price of a guaranteed headline when the tunnel is wedged; set
     ZKSTREAM_BENCH_NO_PROBE=1 to skip it, or
     ZKSTREAM_BENCH_PROBE_TIMEOUT=<seconds> to resize the per-attempt
-    budget (default 240).  No pipes: stderr goes to a temp file so a
-    killed probe (whose tunnel helpers may inherit the descriptors)
-    can never wedge THIS process draining them, and the probe runs in
-    its own session so the whole group is killed on timeout."""
+    budget (default 240).  The probe subprocess mechanics (own
+    session, group kill on timeout, no pipes) live in
+    platform.bounded_probe, shared with tools/tpu_window.py."""
     import os
-    import signal
-    import subprocess
-    import tempfile
+
+    from zkstream_tpu.utils.platform import bounded_probe
 
     if os.environ.get('ZKSTREAM_BENCH_NO_PROBE') == '1':
         return
@@ -932,29 +930,16 @@ def _guard_backend(timeout_s: float | None = None) -> None:
             timeout_s = 240.0
     reason = None
     for attempt in range(2):
-        with tempfile.TemporaryFile() as errf:
-            proc = subprocess.Popen(
-                [sys.executable, '-c', 'import jax; jax.devices()'],
-                stdout=subprocess.DEVNULL, stderr=errf,
-                start_new_session=True)
-            try:
-                rc = proc.wait(timeout=timeout_s)
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-                proc.wait()
-                reason = 'probe timed out after %.0fs (%d attempts)' \
-                    % (timeout_s, attempt + 1)
-                continue
-            if rc == 0:
-                return
-            errf.seek(0)
-            tail = errf.read().decode(errors='replace').strip()
-            reason = 'probe failed: %s' % (
-                tail.splitlines()[-1:] or ['?'])[0]
-            break
+        status, detail = bounded_probe(
+            'import jax; jax.devices()', timeout_s)
+        if status == 'ok':
+            return
+        if status == 'timeout':
+            reason = 'probe timed out after %.0fs (%d attempts)' \
+                % (timeout_s, attempt + 1)
+            continue
+        reason = 'probe failed: %s' % (detail or '?')
+        break
     print('# default JAX backend unavailable (%s); falling back to '
           'the host CPU backend' % (reason,), file=sys.stderr)
     from zkstream_tpu.utils.platform import force_cpu

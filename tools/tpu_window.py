@@ -1,0 +1,131 @@
+"""Seize a healthy window on a flaky accelerator tunnel.
+
+The round-5 axon tunnel was observed *flaky rather than dead*: device
+enumeration hangs indefinitely for long stretches, with brief healthy
+windows in between (PROFILE.md, amended 2026-07-31 — one probe
+enumerated the chip in ~45 s while probes immediately before and
+after hung for up to 25 min).  A fixed pre-run probe can therefore
+miss a window that opens minutes later.  This tool probes in a loop
+(via platform.bounded_probe, the same bounded-subprocess mechanics as
+bench._guard_backend) and the moment enumeration succeeds it runs the
+given command immediately, while the window is open.
+
+Semantics mirror the bench guard's: a probe *timeout* is retried at
+the next interval (the tunnel may open later); a probe *error*
+(nonzero exit — broken plugin, import failure) aborts immediately,
+because backend setup errors are deterministic.  The workload itself
+runs under a hard timeout in its own process group: if the window
+closes mid-run and the command wedges, it is killed and the hunt
+resumes instead of hanging the hunter.
+
+Usage:
+    python tools/tpu_window.py [--budget 150] [--interval 60] \
+        [--max-probes 40] [--cmd-timeout 3600] -- CMD [ARG...]
+
+The command runs with ZKSTREAM_BENCH_NO_PROBE=1 exported (the window
+was just probed; a 240 s in-run probe would squander it).  Exit code:
+the command's; 75 (EX_TEMPFAIL) if no window ever opened; 76 if
+window(s) opened but the workload never completed inside its timeout;
+71 (EX_OSERR) on a deterministic probe error.  A probe that
+enumerates only CPU devices (transient plugin-init failure under a
+flaky tunnel: JAX warns and falls back to host CPU) is retryable,
+not deterministic — it says so on stderr and the hunt continues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from zkstream_tpu.utils.platform import bounded_probe  # noqa: E402
+
+PROBE = ("import sys\n"
+         "import jax\n"
+         "d = jax.devices()\n"
+         "if d and d[0].platform != 'cpu':\n"
+         "    raise SystemExit(0)\n"
+         "print('only cpu devices enumerated', file=sys.stderr)\n"
+         "raise SystemExit(1)\n")
+
+CPU_ONLY = 'only cpu devices enumerated'
+
+
+def run_workload(cmd: list[str], timeout_s: float) -> int | None:
+    """Run cmd in its own process group with a hard timeout; returns
+    its exit code, or None if it wedged and was killed (hunt should
+    resume)."""
+    env = dict(os.environ, ZKSTREAM_BENCH_NO_PROBE='1')
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+    try:
+        return proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--budget', type=float, default=150.0,
+                    help='per-probe enumeration budget, seconds')
+    ap.add_argument('--interval', type=float, default=60.0,
+                    help='sleep between timed-out probes, seconds')
+    ap.add_argument('--max-probes', type=int, default=40)
+    ap.add_argument('--cmd-timeout', type=float, default=3600.0,
+                    help='hard timeout for the workload, seconds')
+    ap.add_argument('cmd', nargs=argparse.REMAINDER,
+                    help='command to run once a window opens '
+                         '(prefix with --)')
+    args = ap.parse_args()
+    cmd = args.cmd[1:] if args.cmd[:1] == ['--'] else args.cmd
+    if not cmd:
+        ap.error('no command given')
+
+    opened = 0
+    for i in range(args.max_probes):
+        t0 = time.time()
+        print('# probe %d/%d at %s' % (i + 1, args.max_probes,
+                                       time.strftime('%H:%M:%S')),
+              file=sys.stderr, flush=True)
+        status, detail = bounded_probe(PROBE, args.budget)
+        if status == 'error' and detail != CPU_ONLY:
+            print('# probe error (deterministic, not retrying): %s'
+                  % (detail or '?'), file=sys.stderr)
+            return 71
+        if status == 'error':
+            print('# %s (transient under a flaky tunnel); retrying'
+                  % CPU_ONLY, file=sys.stderr, flush=True)
+        if status == 'ok':
+            opened += 1
+            print('# window open (enumerated in %.1fs); running: %s'
+                  % (time.time() - t0, ' '.join(cmd)),
+                  file=sys.stderr, flush=True)
+            rc = run_workload(cmd, args.cmd_timeout)
+            if rc is not None:
+                return rc
+            print('# workload wedged past %.0fs and was killed; '
+                  'resuming hunt' % args.cmd_timeout,
+                  file=sys.stderr, flush=True)
+        if i + 1 < args.max_probes:
+            time.sleep(args.interval)
+    if opened:
+        print('# %d window(s) opened but the workload never '
+              'completed' % opened, file=sys.stderr)
+        return 76
+    print('# no window in %d probes' % args.max_probes,
+          file=sys.stderr)
+    return 75
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -15,6 +15,46 @@ from __future__ import annotations
 import os
 
 
+def bounded_probe(code: str, budget_s: float) -> tuple[str, str]:
+    """Run ``python -c code`` in a fresh subprocess with a hard
+    budget; returns ``(status, detail)`` where status is ``'ok'``
+    (exit 0), ``'error'`` (nonzero exit; detail carries the last
+    stderr line), or ``'timeout'`` (killed by process group after the
+    budget).
+
+    This is the one safe way to ask a possibly-wedged tunneled
+    accelerator anything: the child owns its own session so the whole
+    group dies on timeout, and no pipes are held that its tunnel
+    helpers could inherit and wedge the parent draining (stderr goes
+    to a temp file, never a pipe).  Shared by bench._guard_backend
+    and tools/tpu_window.py.
+    """
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(
+            [sys.executable, '-c', code],
+            stdout=subprocess.DEVNULL, stderr=errf,
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=budget_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            return 'timeout', ''
+        if rc == 0:
+            return 'ok', ''
+        errf.seek(0)
+        tail = errf.read().decode(errors='replace').strip()
+        return 'error', (tail.splitlines()[-1:] or ['?'])[0]
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Pin this process's JAX to the host CPU platform.
 
