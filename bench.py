@@ -930,7 +930,7 @@ def _guard_backend(timeout_s: float | None = None) -> None:
             timeout_s = 240.0
     reason = None
     for attempt in range(2):
-        status, detail = bounded_probe(
+        status, detail, _rc = bounded_probe(
             'import jax; jax.devices()', timeout_s)
         if status == 'ok':
             return

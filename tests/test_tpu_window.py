@@ -1,0 +1,105 @@
+"""Contract tests for tools/tpu_window.py — the flaky-tunnel window
+hunter.  The probe/workload subprocess mechanics are driven for real
+elsewhere (platform.bounded_probe's three states, run_workload's
+group-kill) — here the hunt LOOP's classification and exit-code
+contract is pinned with substituted probe/workload functions:
+timeouts and cpu-only fallbacks retry, deterministic errors abort,
+a wedged workload resumes the hunt, and the exit codes distinguish
+'no window ever' (75) from 'window opened, workload never completed'
+(76)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    'tools'))
+
+import tpu_window  # noqa: E402
+
+
+def hunt(monkeypatch, probe_results, workload_results=(),
+         max_probes=4):
+    """Run main() with scripted probe/workload outcomes; returns
+    (exit_code, sleeps, workload_calls)."""
+    probes = iter(probe_results)
+    workloads = iter(workload_results)
+    sleeps: list = []
+    calls: list = []
+
+    monkeypatch.setattr(tpu_window, 'bounded_probe',
+                        lambda code, budget: next(probes))
+    monkeypatch.setattr(
+        tpu_window, 'run_workload',
+        lambda cmd, t: (calls.append(cmd), next(workloads))[1])
+    monkeypatch.setattr(tpu_window.time, 'sleep', sleeps.append)
+    monkeypatch.setattr(
+        sys, 'argv',
+        ['tpu_window.py', '--budget', '1', '--interval', '5',
+         '--max-probes', str(max_probes), '--', 'true'])
+    return tpu_window.main(), sleeps, calls
+
+
+def test_window_opens_runs_workload_returns_its_rc(monkeypatch):
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('timeout', '', -1), ('ok', '', 0)], workload_results=[7])
+    assert rc == 7
+    assert calls == [['true']]
+    assert sleeps == [5.0]        # one sleep after the timed-out probe
+
+
+def test_no_window_exits_75_without_trailing_sleep(monkeypatch):
+    rc, sleeps, calls = hunt(
+        monkeypatch, [('timeout', '', -1)] * 3, max_probes=3)
+    assert rc == 75
+    assert calls == []
+    assert len(sleeps) == 2       # none after the final probe
+
+
+def test_deterministic_probe_error_aborts_71(monkeypatch):
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('timeout', '', -1), ('error', 'ModuleNotFoundError: jax', 1)])
+    assert rc == 71
+    assert calls == []
+
+
+def test_cpu_only_fallback_is_retryable(monkeypatch):
+    """A transient plugin-init failure enumerates only CPU devices;
+    that must retry like a timeout, not abort like an import error."""
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('error', '', tpu_window.CPU_ONLY_RC), ('ok', '', 0)],
+        workload_results=[0])
+    assert rc == 0
+    assert calls == [['true']]
+
+
+def test_wedged_workload_resumes_hunt_then_exits_76(monkeypatch):
+    """A workload killed at --cmd-timeout resumes probing; if no later
+    run completes, the exit code says 'window opened but workload
+    never completed' (76), NOT 'no window' (75)."""
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('ok', '', 0), ('timeout', '', -1), ('ok', '', 0)],
+        workload_results=[None, None], max_probes=3)
+    assert rc == 76
+    assert calls == [['true'], ['true']]
+
+
+def test_wedged_then_completed_workload(monkeypatch):
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('ok', '', 0), ('ok', '', 0)], workload_results=[None, 0])
+    assert rc == 0
+    assert len(calls) == 2
+
+
+def test_no_command_errors(monkeypatch):
+    monkeypatch.setattr(sys, 'argv', ['tpu_window.py'])
+    with pytest.raises(SystemExit) as ei:
+        tpu_window.main()
+    assert ei.value.code == 2

@@ -36,42 +36,37 @@ from __future__ import annotations
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from zkstream_tpu.utils.platform import bounded_probe  # noqa: E402
+from zkstream_tpu.utils.platform import (  # noqa: E402
+    bounded_probe,
+    bounded_run,
+)
 
-PROBE = ("import sys\n"
-         "import jax\n"
+# cpu-only enumeration signals with a dedicated exit code: stderr
+# content is unreliable for classification (the PJRT runtime may
+# append teardown warnings after the marker line).
+CPU_ONLY_RC = 3
+
+PROBE = ("import jax\n"
          "d = jax.devices()\n"
          "if d and d[0].platform != 'cpu':\n"
          "    raise SystemExit(0)\n"
-         "print('only cpu devices enumerated', file=sys.stderr)\n"
-         "raise SystemExit(1)\n")
-
-CPU_ONLY = 'only cpu devices enumerated'
+         "raise SystemExit(%d)\n" % CPU_ONLY_RC)
 
 
 def run_workload(cmd: list[str], timeout_s: float) -> int | None:
-    """Run cmd in its own process group with a hard timeout; returns
-    its exit code, or None if it wedged and was killed (hunt should
-    resume)."""
+    """Run cmd via bounded_run (inherited stdio, own process group,
+    hard timeout); returns its exit code, or None if it wedged and
+    was killed (hunt should resume).  ZKSTREAM_BENCH_NO_PROBE=1 is
+    exported for the child: the window was just probed."""
     env = dict(os.environ, ZKSTREAM_BENCH_NO_PROBE='1')
-    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
-    try:
-        return proc.wait(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except OSError:
-            pass
-        proc.wait()
-        return None
+    status, _detail, rc = bounded_run(cmd, timeout_s, env=env)
+    return None if status == 'timeout' else rc
 
 
 def main() -> int:
@@ -97,14 +92,15 @@ def main() -> int:
         print('# probe %d/%d at %s' % (i + 1, args.max_probes,
                                        time.strftime('%H:%M:%S')),
               file=sys.stderr, flush=True)
-        status, detail = bounded_probe(PROBE, args.budget)
-        if status == 'error' and detail != CPU_ONLY:
+        status, detail, rc = bounded_probe(PROBE, args.budget)
+        if status == 'error' and rc != CPU_ONLY_RC:
             print('# probe error (deterministic, not retrying): %s'
                   % (detail or '?'), file=sys.stderr)
             return 71
         if status == 'error':
-            print('# %s (transient under a flaky tunnel); retrying'
-                  % CPU_ONLY, file=sys.stderr, flush=True)
+            print('# only cpu devices enumerated (transient under a '
+                  'flaky tunnel); retrying', file=sys.stderr,
+                  flush=True)
         if status == 'ok':
             opened += 1
             print('# window open (enumerated in %.1fs); running: %s'

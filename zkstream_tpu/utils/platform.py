@@ -15,30 +15,38 @@ from __future__ import annotations
 import os
 
 
-def bounded_probe(code: str, budget_s: float) -> tuple[str, str]:
-    """Run ``python -c code`` in a fresh subprocess with a hard
-    budget; returns ``(status, detail)`` where status is ``'ok'``
-    (exit 0), ``'error'`` (nonzero exit; detail carries the last
-    stderr line), or ``'timeout'`` (killed by process group after the
-    budget).
+def bounded_run(argv: list[str], budget_s: float,
+                capture_stderr: bool = False,
+                env: dict | None = None) -> tuple[str, str, int]:
+    """Run ``argv`` in its own process group with a hard budget;
+    returns ``(status, detail, rc)`` where status is ``'ok'``
+    (exit 0), ``'error'`` (nonzero exit), or ``'timeout'`` (whole
+    group SIGKILLed after the budget; rc is -1).  With
+    ``capture_stderr``, stdout is discarded and detail carries the
+    child's last stderr line on error — via a temp file, never a
+    pipe, so a killed child (whose tunnel helpers may inherit the
+    descriptors) can never wedge THIS process draining it; without
+    it, stdio is inherited (workload mode).
 
-    This is the one safe way to ask a possibly-wedged tunneled
-    accelerator anything: the child owns its own session so the whole
-    group dies on timeout, and no pipes are held that its tunnel
-    helpers could inherit and wedge the parent draining (stderr goes
-    to a temp file, never a pipe).  Shared by bench._guard_backend
-    and tools/tpu_window.py.
+    This is the one copy of the bounded-subprocess mechanics for
+    talking to a possibly-wedged tunneled accelerator, shared by
+    bench._guard_backend and tools/tpu_window.py (probe and workload
+    both).
     """
+    import contextlib
     import signal
     import subprocess
-    import sys
     import tempfile
 
-    with tempfile.TemporaryFile() as errf:
-        proc = subprocess.Popen(
-            [sys.executable, '-c', code],
-            stdout=subprocess.DEVNULL, stderr=errf,
-            start_new_session=True)
+    with contextlib.ExitStack() as stack:
+        kw: dict = {}
+        if env is not None:
+            kw['env'] = env
+        errf = None
+        if capture_stderr:
+            errf = stack.enter_context(tempfile.TemporaryFile())
+            kw.update(stdout=subprocess.DEVNULL, stderr=errf)
+        proc = subprocess.Popen(argv, start_new_session=True, **kw)
         try:
             rc = proc.wait(timeout=budget_s)
         except subprocess.TimeoutExpired:
@@ -47,12 +55,24 @@ def bounded_probe(code: str, budget_s: float) -> tuple[str, str]:
             except OSError:
                 pass
             proc.wait()
-            return 'timeout', ''
+            return 'timeout', '', -1
         if rc == 0:
-            return 'ok', ''
-        errf.seek(0)
-        tail = errf.read().decode(errors='replace').strip()
-        return 'error', (tail.splitlines()[-1:] or ['?'])[0]
+            return 'ok', '', 0
+        detail = ''
+        if errf is not None:
+            errf.seek(0)
+            tail = errf.read().decode(errors='replace').strip()
+            detail = (tail.splitlines()[-1:] or ['?'])[0]
+        return 'error', detail, rc
+
+
+def bounded_probe(code: str, budget_s: float) -> tuple[str, str, int]:
+    """``bounded_run`` over ``python -c code`` with stderr capture —
+    the probe form used against a possibly-wedged accelerator."""
+    import sys
+
+    return bounded_run([sys.executable, '-c', code], budget_s,
+                       capture_stderr=True)
 
 
 def force_cpu(n_devices: int | None = None) -> None:
