@@ -32,6 +32,16 @@ asan:
 bench:
 	$(PYTHON) bench.py
 
+# Hunt a healthy window on a flaky accelerator tunnel, then run the
+# full TPU validation workload in it: the bench plus both pallas
+# sweeps (header rows and the fused full-decode confirmation rows).
+# See tools/tpu_window.py and PROFILE.md "Accelerator status".
+hunt:
+	$(PYTHON) tools/tpu_window.py --cmd-timeout 5400 -- bash -c '\
+	    $(PYTHON) bench.py && \
+	    $(PYTHON) tools/sweep_pallas.py && \
+	    $(PYTHON) tools/sweep_pallas.py --full'
+
 # Line coverage (reference Makefile:61-66 istanbul analogue).  No
 # coverage package in this image; tools/cover.py implements it on
 # sys.monitoring (PEP 669) — once-per-line callbacks with DISABLE, so
