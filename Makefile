@@ -35,12 +35,16 @@ bench:
 # Hunt a healthy window on a flaky accelerator tunnel, then run the
 # full TPU validation workload in it: the bench plus both pallas
 # sweeps (header rows and the fused full-decode confirmation rows).
+# Each stage gets its own hunt + timeout so a wedge in a later stage
+# never discards completed earlier stages (windows are scarce).
 # See tools/tpu_window.py and PROFILE.md "Accelerator status".
 hunt:
-	$(PYTHON) tools/tpu_window.py --cmd-timeout 5400 -- bash -c '\
-	    $(PYTHON) bench.py && \
-	    $(PYTHON) tools/sweep_pallas.py && \
-	    $(PYTHON) tools/sweep_pallas.py --full'
+	$(PYTHON) tools/tpu_window.py --cmd-timeout 2700 -- \
+	    $(PYTHON) bench.py
+	$(PYTHON) tools/tpu_window.py --cmd-timeout 1800 -- \
+	    $(PYTHON) tools/sweep_pallas.py
+	$(PYTHON) tools/tpu_window.py --cmd-timeout 1800 -- \
+	    $(PYTHON) tools/sweep_pallas.py --full
 
 # Line coverage (reference Makefile:61-66 istanbul analogue).  No
 # coverage package in this image; tools/cover.py implements it on
