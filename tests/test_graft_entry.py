@@ -21,12 +21,16 @@ def test_entry_compiles_and_runs():
     assert not bool(out.bad.any())
 
 
-def test_dryrun_multichip_subprocess():
-    # own process: dryrun must win the platform race before backend init
+@pytest.mark.parametrize('n', [8, 3])
+def test_dryrun_multichip_subprocess(n):
+    # own process: dryrun must win the platform race before backend
+    # init.  n=3 pins the odd-count case: sp collapses to 1 and every
+    # example shape must still shard evenly over dp (the driver may
+    # pick any device count)
     r = subprocess.run(
         [sys.executable, '-c',
          f'import sys; sys.path.insert(0, {ROOT!r}); '
-         'import __graft_entry__ as ge; ge.dryrun_multichip(8)'],
+         f'import __graft_entry__ as ge; ge.dryrun_multichip({n})'],
         capture_output=True, text=True, timeout=600, cwd=ROOT)
     assert r.returncode == 0, r.stderr
     assert 'OK' in r.stdout
