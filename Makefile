@@ -6,12 +6,21 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench asan coverage clean
+.PHONY: all test check native bench asan chaos coverage clean
 
 all: check test
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
+
+# Bounded seeded chaos campaign (<= 60 s): fault-injection schedules
+# + the resilience tests (deadlines, degraded mode, member kills).
+# Same invariants as the full tier-1 campaign, smaller slice; rerun
+# any failing seed with `python -m zkstream_tpu chaos --seed N`.
+# Scale with ZKSTREAM_CHAOS_SCHEDULES / ZKSTREAM_CHAOS_SEED.
+chaos:
+	ZKSTREAM_CHAOS_SCHEDULES=$${ZKSTREAM_CHAOS_SCHEDULES:-60} \
+	    $(PYTHON) -m pytest tests/test_chaos.py -q -m 'not slow'
 
 check:
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)

@@ -317,6 +317,25 @@ def bench_ext_full(streams, slots) -> float | None:
     return total / dt / (1024 * 1024)
 
 
+#: Device-OOM signatures worth a serialized retry.  Deliberately a
+#: tight allowlist (XLA's RESOURCE_EXHAUSTED status, the literal
+#: "out of memory" phrasing, an OOM token): the old bare
+#: ``'memory' in str(e)`` substring also matched deterministic
+#: failures that merely *mentioned* memory (e.g. layout/"memory
+#: space" errors), and re-running heavy dispatches behind one of
+#: those wastes a scarce tunnel window.
+_OOM_SIGNATURES = ('RESOURCE_EXHAUSTED', 'OOM')
+
+
+def _is_oom(e: BaseException) -> bool:
+    msg = str(e)
+    # The all-caps tokens must match case-sensitively: lowercasing
+    # 'OOM' would turn it into a bare 'oom' substring and re-admit
+    # false positives ('zoomed', 'Bloom').
+    return (any(sig in msg for sig in _OOM_SIGNATURES)
+            or 'out of memory' in msg.lower())
+
+
 def bench_tensor(buf, lens, streams, pkts, slots
                  ) -> tuple[float, float, float]:
     """Tensor pipeline MiB/s on the default JAX device: the protocol
@@ -426,8 +445,7 @@ def bench_tensor(buf, lens, streams, pkts, slots
         try:
             dts = time_rounds(inflight or reps)
         except Exception as e:
-            oom = 'RESOURCE_EXHAUSTED' in str(e) or 'memory' in \
-                str(e).lower()
+            oom = _is_oom(e)
             if inflight is None or inflight <= 1 or not oom:
                 raise
             # a device OOM mid-timing (big planes, small chip) must
@@ -966,6 +984,13 @@ def _guard_backend(timeout_s: float | None = None) -> None:
         if status == 'timeout':
             reason = 'probe timed out after %.0fs (%d attempts)' \
                 % (timeout_s, attempt + 1)
+            continue
+        if status == 'killed':
+            # signal-killed: environmental (OOM killer, tunnel-side
+            # abort), retried like a timeout — not a deterministic
+            # backend setup error
+            reason = 'probe killed by a signal (%s, %d attempts)' \
+                % (detail or '?', attempt + 1)
             continue
         reason = 'probe failed: %s' % (detail or '?')
         break

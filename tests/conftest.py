@@ -82,6 +82,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'timeout(seconds): per-test budget override for '
         'the async runner (default 30 s)')
+    config.addinivalue_line(
+        'markers', 'slow: excluded from the tier-1 fast suite '
+        "(run with -m 'not slow'); the chaos campaign and every "
+        'default test stay tier-1 compatible')
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -81,6 +81,30 @@ def test_force_cpu_after_jax_import_subprocess():
     assert 'FORCED-CPU-OK' in out.stdout
 
 
+# -- bounded_run child classification --
+
+def test_bounded_run_classifies_signal_killed_child():
+    """A child that dies on a signal (rc < 0) is 'killed' — distinct
+    from a deterministic nonzero exit ('error'), so hunt loops retry
+    it like a timeout instead of aborting."""
+    import signal
+
+    from zkstream_tpu.utils.platform import bounded_run
+
+    status, detail, rc = bounded_run(
+        [sys.executable, '-c',
+         'import os, signal; os.kill(os.getpid(), signal.SIGKILL)'],
+        30, capture_stderr=True)
+    assert status == 'killed'
+    assert rc == -signal.SIGKILL
+    assert detail    # carries at least the signal number
+
+    status, _detail, rc = bounded_run(
+        [sys.executable, '-c', 'raise SystemExit(3)'], 30,
+        capture_stderr=True)
+    assert status == 'error' and rc == 3
+
+
 # -- the bench's backend probe --
 
 def _fake_popen_factory(behavior: str, calls: list):

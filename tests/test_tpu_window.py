@@ -98,6 +98,43 @@ def test_wedged_then_completed_workload(monkeypatch):
     assert len(calls) == 2
 
 
+def test_signal_killed_probe_is_retryable(monkeypatch):
+    """A probe killed by a signal (rc < 0: OOM killer, tunnel-side
+    abort) is environmental — it must retry like a timeout, never
+    abort the hunt with 71 (the deterministic-error code)."""
+    rc, sleeps, calls = hunt(
+        monkeypatch,
+        [('killed', 'signal 9', -9), ('ok', '', 0)],
+        workload_results=[0])
+    assert rc == 0
+    assert calls == [['true']]
+
+
+def test_signal_killed_workload_resumes_hunt(monkeypatch):
+    """run_workload reports a signal-killed child as None (resume the
+    hunt), same as a budget timeout."""
+    monkeypatch.setattr(
+        tpu_window, 'bounded_run',
+        lambda cmd, t, env=None: ('killed', 'signal 9', -9))
+    assert tpu_window.run_workload(['x'], 1.0) is None
+
+
+def test_sentinel_colliding_workload_rc_is_remapped(monkeypatch):
+    """A workload exiting with one of the hunter's own sentinel codes
+    (71/75/76) is remapped into the reserved band so the caller can
+    always tell whose verdict the exit code is."""
+    for raw, mapped in tpu_window.SENTINEL_REMAP.items():
+        monkeypatch.setattr(
+            tpu_window, 'bounded_run',
+            lambda cmd, t, env=None, raw=raw: ('error', '', raw))
+        assert tpu_window.run_workload(['x'], 1.0) == mapped
+    # non-colliding codes pass through untouched
+    monkeypatch.setattr(
+        tpu_window, 'bounded_run',
+        lambda cmd, t, env=None: ('error', '', 7))
+    assert tpu_window.run_workload(['x'], 1.0) == 7
+
+
 def test_no_command_errors(monkeypatch):
     monkeypatch.setattr(sys, 'argv', ['tpu_window.py'])
     with pytest.raises(SystemExit) as ei:

@@ -29,7 +29,15 @@ window(s) opened but the workload never completed inside its timeout;
 71 (EX_OSERR) on a deterministic probe error.  A probe that
 enumerates only CPU devices (transient plugin-init failure under a
 flaky tunnel: JAX warns and falls back to host CPU) is retryable,
-not deterministic — it says so on stderr and the hunt continues.
+not deterministic — it says so on stderr and the hunt continues.  A
+*signal-killed* probe or workload (rc < 0: OOM killer, tunnel-side
+abort) is likewise environmental and retried like a timeout, never
+treated as the deterministic-error abort.
+
+The exit codes 71/75/76 are the hunter's own sentinels; a workload
+that happens to exit with one of them would be indistinguishable
+from the hunter's verdict, so those are remapped into the reserved
+band 101/102/103 (with a note on stderr).
 """
 
 from __future__ import annotations
@@ -59,14 +67,31 @@ PROBE = ("import jax\n"
          "raise SystemExit(%d)\n" % CPU_ONLY_RC)
 
 
+#: Workload exit codes that collide with the hunter's own sentinels
+#: (71 probe error, 75 no window, 76 never completed) are remapped
+#: into this reserved band so callers can always tell whose verdict
+#: an exit code is.
+SENTINEL_REMAP = {71: 101, 75: 102, 76: 103}
+
+
 def run_workload(cmd: list[str], timeout_s: float) -> int | None:
     """Run cmd via bounded_run (inherited stdio, own process group,
     hard timeout); returns its exit code, or None if it wedged and
-    was killed (hunt should resume).  ZKSTREAM_BENCH_NO_PROBE=1 is
-    exported for the child: the window was just probed."""
+    was killed (hunt should resume) — a timeout kill by the budget
+    and a signal kill from outside (OOM killer, tunnel abort) are
+    both environmental, so both resume the hunt.
+    ZKSTREAM_BENCH_NO_PROBE=1 is exported for the child: the window
+    was just probed."""
     env = dict(os.environ, ZKSTREAM_BENCH_NO_PROBE='1')
     status, _detail, rc = bounded_run(cmd, timeout_s, env=env)
-    return None if status == 'timeout' else rc
+    if status in ('timeout', 'killed'):
+        return None
+    if rc in SENTINEL_REMAP:
+        print('# workload exited with %d, which collides with a '
+              'hunter sentinel; remapping to %d'
+              % (rc, SENTINEL_REMAP[rc]), file=sys.stderr, flush=True)
+        return SENTINEL_REMAP[rc]
+    return rc
 
 
 def main() -> int:
@@ -101,6 +126,11 @@ def main() -> int:
             print('# only cpu devices enumerated (transient under a '
                   'flaky tunnel); retrying', file=sys.stderr,
                   flush=True)
+        if status == 'killed':
+            # signal-killed (rc < 0): environmental, like a timeout —
+            # never the deterministic-error abort
+            print('# probe killed by a signal (%s); retrying'
+                  % (detail or '?'), file=sys.stderr, flush=True)
         if status == 'ok':
             opened += 1
             print('# window open (enumerated in %.1fs); running: %s'

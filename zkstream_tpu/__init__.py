@@ -35,6 +35,7 @@ __version__ = '0.1.0'
 from .client import Client  # noqa: F401
 from .protocol.consts import CreateFlag, Perm  # noqa: F401
 from .protocol.errors import (  # noqa: F401
+    ZKDeadlineError,
     ZKError,
     ZKNotConnectedError,
     ZKPingTimeoutError,

@@ -275,11 +275,61 @@ def build_parser() -> argparse.ArgumentParser:
     wa.add_argument('path')
     wa.add_argument('--count', '-n', type=int, default=0,
                     help='exit after N events (default: forever)')
+
+    ch = sub.add_parser(
+        'chaos',
+        help='run seeded fault-injection schedules against an '
+             'in-process server and verify the resilience invariants')
+    ch.add_argument('--seed', type=int, default=0,
+                    help='base seed; schedule i uses seed+i (default 0)')
+    ch.add_argument('--schedules', type=int, default=20,
+                    help='number of consecutive seeded schedules')
+    ch.add_argument('--ops', type=int, default=6,
+                    help='client ops per schedule')
+    ch.add_argument('--quiet', action='store_true',
+                    help='only print failing schedules + the summary')
     return p
+
+
+async def _chaos(args) -> int:
+    """Drive the seeded chaos campaign (io/faults.py) and report.
+    Exit 0 when every schedule's invariants held, 1 otherwise; each
+    line carries the seed, so any failure reruns with --seed N."""
+    from .io.faults import run_campaign
+
+    def progress(r):
+        if args.quiet and r.ok:
+            return
+        status = 'ok ' if r.ok else 'FAIL'
+        print('seed %6d  %s  ops=%d acked=%d typed_errs=%d '
+              'deadline=%d faults=%d watch_fires=%d'
+              % (r.seed, status, r.ops, r.acked, r.typed_errors,
+                 r.deadline_errors, r.faults, r.watch_fires))
+        for v in r.violations:
+            print('    violation: %s' % (v,))
+
+    results = await run_campaign(args.seed, args.schedules,
+                                 ops=args.ops, progress=progress)
+    bad = [r for r in results if not r.ok]
+    print('%d/%d schedules ok (%d faults injected, %d typed errors, '
+          '%d deadline errors)'
+          % (len(results) - len(bad), len(results),
+             sum(r.faults for r in results),
+             sum(r.typed_errors for r in results),
+             sum(r.deadline_errors for r in results)))
+    if bad:
+        print('failing seeds: %s' % (', '.join(str(r.seed)
+                                               for r in bad),),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == 'chaos':
+        # chaos runs its own in-process servers; no --server dial.
+        return asyncio.run(_chaos(args))
     return asyncio.run(_run(args))
 
 

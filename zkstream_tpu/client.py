@@ -25,18 +25,18 @@ from __future__ import annotations
 
 import asyncio
 
+from .io.backoff import BackoffPolicy
 from .io.connection import Backend, ZKConnection
 from .io.pool import (
     DEFAULT_CONNECT_POLICY,
     DEFAULT_DECOHERENCE_INTERVAL,
     DEFAULT_POLICY,
     ConnectionPool,
-    RecoveryPolicy,
 )
 from .io.session import ZKSession
 from .io.watcher import ZKWatcher
 from .protocol.consts import CreateFlag
-from .protocol.errors import ZKNotConnectedError
+from .protocol.errors import ZKDeadlineError, ZKNotConnectedError
 from .protocol.records import OPEN_ACL_UNSAFE, Stat
 from .utils.aio import ambient_loop
 from .utils.fsm import FSM
@@ -44,9 +44,20 @@ from .utils.logging import Logger
 from .utils.metrics import Collector
 
 METRIC_ZK_EVENT_COUNTER = 'zookeeper_events'
+METRIC_ZK_DEGRADED_GAUGE = 'zookeeper_degraded'
 
 #: Default session timeout, ms (reference: lib/client.js:80-83).
 DEFAULT_SESSION_TIMEOUT = 30000
+
+#: Default per-request deadline, ms.  Every znode op either completes
+#: or raises a typed :class:`ZKDeadlineError` within this budget —
+#: an op must never hang silently on a dead connection.  Pass
+#: ``op_timeout=None`` (or ``deadline=None`` per op) for the old
+#: unbounded behavior.
+DEFAULT_OP_TIMEOUT = 30000
+
+#: Sentinel: "no per-op override, use the client default".
+_USE_DEFAULT = object()
 
 
 class Client(FSM):
@@ -54,8 +65,8 @@ class Client(FSM):
                  servers: list[tuple[str, int] | dict] | None = None,
                  session_timeout: int = DEFAULT_SESSION_TIMEOUT,
                  collector: Collector | None = None,
-                 connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
-                 default_policy: RecoveryPolicy = DEFAULT_POLICY,
+                 connect_policy: BackoffPolicy = DEFAULT_CONNECT_POLICY,
+                 default_policy: BackoffPolicy = DEFAULT_POLICY,
                  decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
                  shuffle_backends: bool = True,
                  seed: int | None = None,
@@ -63,7 +74,9 @@ class Client(FSM):
                  ingest=None,
                  use_native_codec: bool | None = None,
                  on_fatal=None,
-                 max_spares: int = 2):
+                 max_spares: int = 2,
+                 op_timeout: int | None = DEFAULT_OP_TIMEOUT,
+                 faults=None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -99,6 +112,13 @@ class Client(FSM):
         #: (loop exception handler).  See ZKSession.fatal_error.
         self.on_fatal = on_fatal
 
+        #: Optional FaultInjector (io/faults.py): threaded to every
+        #: connection this client dials; None in production.
+        self.faults = faults
+        #: Per-request deadline, ms (None = unbounded).  Ops exceeding
+        #: it raise :class:`ZKDeadlineError` instead of hanging.
+        self.op_timeout = op_timeout
+
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
             'Total number of zookeeper events')
@@ -106,6 +126,8 @@ class Client(FSM):
         self.session_timeout = session_timeout
         self.session: ZKSession | None = None
         self.old_session: ZKSession | None = None
+        self._retry_policy = default_policy
+        self._seed = seed
 
         self.pool = ConnectionPool(
             self, backends,
@@ -115,6 +137,22 @@ class Client(FSM):
             shuffle=shuffle_backends, seed=seed,
             max_spares=max_spares)
         self.pool.on('stateChanged', self._on_pool_state_changed)
+        # Degraded-mode surface: re-emit the pool's circuit-breaker
+        # edges on the client, count them, and expose the current state
+        # as a pull gauge (1 = all backends failing, parked in monitor
+        # mode; 0 = healthy).
+        self.pool.on('degraded', lambda: self._emit_tracked('degraded'))
+        self.pool.on('recovered',
+                     lambda: self._emit_tracked('recovered'))
+        try:
+            self.collector.gauge(
+                METRIC_ZK_DEGRADED_GAUGE,
+                lambda: 1.0 if self.pool.degraded else 0.0,
+                'Client degraded mode (1 = all backends failing)')
+        except ValueError:
+            # Shared collector across clients: the first registrant's
+            # pool owns the series.
+            pass
 
         self._started = False
         super().__init__('normal')
@@ -174,7 +212,8 @@ class Client(FSM):
     def _new_session(self) -> None:
         if not self.is_in_state('normal'):
             return
-        s = ZKSession(self.session_timeout, self.collector, log=self.log)
+        s = ZKSession(self.session_timeout, self.collector, log=self.log,
+                      retry_policy=self._retry_policy, seed=self._seed)
         s.fatal_handler = self.on_fatal
         self.session = s
 
@@ -216,9 +255,20 @@ class Client(FSM):
         return self.session
 
     def _event_track(self, evt: str) -> None:
-        if evt in ('session', 'connect', 'failed'):
+        if evt in ('session', 'connect', 'failed', 'degraded',
+                   'recovered'):
             self.collector.get_collector(
                 METRIC_ZK_EVENT_COUNTER).increment({'evtype': evt})
+
+    def _emit_tracked(self, evt: str) -> None:
+        self._event_track(evt)
+        self.emit(evt)
+
+    def is_degraded(self) -> bool:
+        """True while the circuit breaker is open: every backend
+        failed the full retry policy and the pool is parked in
+        jittered monitor-mode redial."""
+        return self.pool.degraded
 
     def _emit_after_connected(self, evt: str) -> None:
         """Defer an event until the connection can actually serve
@@ -328,7 +378,25 @@ class Client(FSM):
 
     # -- operations (reference: lib/client.js:318-601) --
 
-    async def ping(self) -> float:
+    async def _await_op(self, fut: asyncio.Future, opcode: str,
+                        path: str | None, deadline) -> dict:
+        """Bound one request future by the per-request deadline.
+
+        ``deadline`` is the per-op override in ms (``_USE_DEFAULT`` =
+        the client's ``op_timeout``; ``None`` = unbounded).  On expiry
+        the op fails fast with a typed :class:`ZKDeadlineError` instead
+        of hanging on a dead or wedged connection; the underlying
+        request is cancelled for the caller, and the connection's
+        teardown paths still settle it exactly once internally."""
+        ms = self.op_timeout if deadline is _USE_DEFAULT else deadline
+        if ms is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, ms / 1000.0)
+        except asyncio.TimeoutError:
+            raise ZKDeadlineError(opcode, path, ms) from None
+
+    async def ping(self, deadline=_USE_DEFAULT) -> float:
         """Round-trip a ping; resolves to the latency in ms."""
         conn = self._conn_or_raise()
         loop = ambient_loop()
@@ -342,25 +410,30 @@ class Client(FSM):
             else:
                 fut.set_result(latency)
         conn.ping(cb)
-        return await fut
+        return await self._await_op(fut, 'PING', None, deadline)
 
-    async def list(self, path: str) -> tuple[list[str], Stat]:
+    async def list(self, path: str,
+                   deadline=_USE_DEFAULT) -> tuple[list[str], Stat]:
         """Children of a znode, with its stat."""
         self._check_path(path)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'GET_CHILDREN2', 'path': path,
-                                  'watch': False}).as_future()
+        fut = conn.request({'opcode': 'GET_CHILDREN2', 'path': path,
+                            'watch': False}).as_future()
+        pkt = await self._await_op(fut, 'GET_CHILDREN2', path, deadline)
         return pkt['children'], pkt['stat']
 
-    async def get(self, path: str) -> tuple[bytes, Stat]:
+    async def get(self, path: str,
+                  deadline=_USE_DEFAULT) -> tuple[bytes, Stat]:
         self._check_path(path)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'GET_DATA', 'path': path,
-                                  'watch': False}).as_future()
+        fut = conn.request({'opcode': 'GET_DATA', 'path': path,
+                            'watch': False}).as_future()
+        pkt = await self._await_op(fut, 'GET_DATA', path, deadline)
         return pkt['data'], pkt['stat']
 
     async def create(self, path: str, data: bytes,
-                     acl=None, flags: CreateFlag | int = 0) -> str:
+                     acl=None, flags: CreateFlag | int = 0,
+                     deadline=_USE_DEFAULT) -> str:
         """Create a znode; resolves to the created path (which differs
         from the request path for SEQUENTIAL nodes)."""
         self._check_path(path)
@@ -368,14 +441,16 @@ class Client(FSM):
         if acl is None:
             acl = list(OPEN_ACL_UNSAFE)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'CREATE', 'path': path,
-                                  'data': data, 'acl': acl,
-                                  'flags': CreateFlag(flags)}).as_future()
+        fut = conn.request({'opcode': 'CREATE', 'path': path,
+                            'data': data, 'acl': acl,
+                            'flags': CreateFlag(flags)}).as_future()
+        pkt = await self._await_op(fut, 'CREATE', path, deadline)
         return pkt['path']
 
     async def create_with_empty_parents(self, path: str, data: bytes,
                                         acl=None,
-                                        flags: CreateFlag | int = 0) -> str:
+                                        flags: CreateFlag | int = 0,
+                                        deadline=_USE_DEFAULT) -> str:
         """Create a znode, creating any missing parents as plain
         persistent nodes with data b'null'; NODE_EXISTS on a parent is
         fine, on the leaf it is an error.  Options apply only to the
@@ -395,14 +470,15 @@ class Client(FSM):
                     current,
                     data if last else b'null',
                     acl=acl if last else None,
-                    flags=flags if last else 0)
+                    flags=flags if last else 0,
+                    deadline=deadline)
             except ZKError as e:
                 if last or e.code != 'NODE_EXISTS':
                     raise
         return result
 
     async def set(self, path: str, data: bytes,
-                  version: int = -1) -> Stat:
+                  version: int = -1, deadline=_USE_DEFAULT) -> Stat:
         """Set a znode's data; resolves to the new stat.  (The reference
         passes its callback a path field SET_DATA replies do not carry,
         lib/client.js:503-504 — the stat is the useful payload.)"""
@@ -410,38 +486,44 @@ class Client(FSM):
         self._check_data(data)
         self._check_version(version)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'SET_DATA', 'path': path,
-                                  'data': data,
-                                  'version': version}).as_future()
+        fut = conn.request({'opcode': 'SET_DATA', 'path': path,
+                            'data': data,
+                            'version': version}).as_future()
+        pkt = await self._await_op(fut, 'SET_DATA', path, deadline)
         return pkt['stat']
 
-    async def delete(self, path: str, version: int) -> None:
+    async def delete(self, path: str, version: int,
+                     deadline=_USE_DEFAULT) -> None:
         self._check_path(path)
         self._check_version(version)
         conn = self._conn_or_raise()
-        await conn.request({'opcode': 'DELETE', 'path': path,
+        fut = conn.request({'opcode': 'DELETE', 'path': path,
                             'version': version}).as_future()
+        await self._await_op(fut, 'DELETE', path, deadline)
 
-    async def stat(self, path: str) -> Stat:
+    async def stat(self, path: str, deadline=_USE_DEFAULT) -> Stat:
         self._check_path(path)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'EXISTS', 'path': path,
-                                  'watch': False}).as_future()
+        fut = conn.request({'opcode': 'EXISTS', 'path': path,
+                            'watch': False}).as_future()
+        pkt = await self._await_op(fut, 'EXISTS', path, deadline)
         return pkt['stat']
 
-    async def get_acl(self, path: str):
+    async def get_acl(self, path: str, deadline=_USE_DEFAULT):
         self._check_path(path)
         conn = self._conn_or_raise()
-        pkt = await conn.request({'opcode': 'GET_ACL',
-                                  'path': path}).as_future()
+        fut = conn.request({'opcode': 'GET_ACL',
+                            'path': path}).as_future()
+        pkt = await self._await_op(fut, 'GET_ACL', path, deadline)
         return pkt['acl']
 
-    async def sync(self, path: str) -> None:
+    async def sync(self, path: str, deadline=_USE_DEFAULT) -> None:
         """Flush the leader pipeline to the connected server
         (reference: lib/client.js:578-597)."""
         self._check_path(path)
         conn = self._conn_or_raise()
-        await conn.request({'opcode': 'SYNC', 'path': path}).as_future()
+        fut = conn.request({'opcode': 'SYNC', 'path': path}).as_future()
+        await self._await_op(fut, 'SYNC', path, deadline)
 
     def watcher(self, path: str) -> ZKWatcher:
         self._check_path(path)

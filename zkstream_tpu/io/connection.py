@@ -74,7 +74,7 @@ class _SocketProtocol(asyncio.Protocol):
         self._conn.emit('sockConnect')
 
     def data_received(self, data: bytes) -> None:
-        self._conn.emit('sockData', data)
+        self._conn._sock_data(data)
 
     def eof_received(self) -> bool:
         self._conn.emit('sockEnd')
@@ -113,6 +113,10 @@ class ZKConnection(FSM):
         #: connected-state bytes drain through the batched device
         #: pipeline instead of the per-socket scalar codec.
         self.ingest = getattr(client, 'ingest', None)
+        #: Optional FaultInjector (io/faults.py): when the owning
+        #: client carries one, dials, received bytes and outbound
+        #: frames route through its seeded fault schedule.
+        self.faults = getattr(client, 'faults', None)
         self.last_error: Exception | None = None
         self._xid = 0
         #: xid -> ZKRequest for everything awaiting a reply
@@ -161,6 +165,9 @@ class ZKConnection(FSM):
         async def dial():
             loop = asyncio.get_running_loop()
             try:
+                if self.faults is not None:
+                    # injected reconnect latency and/or refusal
+                    await self.faults.before_connect(self.backend.key)
                 await loop.create_connection(
                     lambda: _SocketProtocol(self),
                     self.backend.address, self.backend.port)
@@ -436,6 +443,9 @@ class ZKConnection(FSM):
         if self._dial_task is not None and not self._dial_task.done():
             self._dial_task.cancel()
         self._dial_task = None
+        gate = getattr(self, '_fault_rx_gate', None)
+        if gate is not None:
+            gate.close()
         if self.transport is not None:
             try:
                 self.transport.abort()
@@ -457,8 +467,21 @@ class ZKConnection(FSM):
 
     # -- request plumbing --
 
+    def _sock_data(self, data: bytes) -> None:
+        """Socket bytes -> 'sockData', via the fault schedule when an
+        injector is installed (splits/delays/dups/mid-frame resets)."""
+        if self.faults is None:
+            self.emit('sockData', data)
+        else:
+            self.faults.rx(self, data)
+
     def _write(self, pkt: dict) -> None:
         data = self.codec.encode(pkt)
+        if self.faults is not None:
+            # may truncate the frame and schedule an injected reset
+            data = self.faults.tx(self, data)
+            if data is None:
+                return
         if self.transport is not None:
             self.transport.write(data)
 

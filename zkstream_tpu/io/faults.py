@@ -1,0 +1,601 @@
+"""Deterministic, seedable fault injection for the transport stack.
+
+The whole value of this client is surviving failure — session
+resumption, watcher re-arm, retry policies — yet hand-rolled failure
+tests only ever exercise the failure modes someone thought of.  This
+module injects faults *at the byte/socket boundary* on a seeded
+schedule, so randomized-but-reproducible campaigns (tests/test_chaos.py,
+``python -m zkstream_tpu chaos``) can drive the stack through fault
+interleavings nobody hand-wrote:
+
+- **connection refusal** and **added connect latency** (client dial);
+- **mid-frame TCP resets** in either direction (a frame's prefix is
+  delivered, then the connection dies);
+- **partial/slow frame delivery** (byte-level splits with delays);
+- **delayed and duplicated segments** (a duplicated stream segment is
+  a framing-corruption-class fault: it must surface as a typed
+  protocol error and a reconnect, never a hang or a wrong reply);
+- **accept-loop refusal** on the server;
+- **asymmetric partition** between replication peers (the leader's
+  push channel to one follower drops while the follower's control
+  channel still flows — server/replication.py);
+- member **crash scheduling** helpers (the campaign SIGKILLs / stops
+  ensemble members at injector-chosen points).
+
+Determinism: every decision is drawn from a per-category
+``random.Random`` seeded from ``(seed, category)`` (string seeding
+hashes via SHA-512, stable across processes).  The *schedule* — the
+sequence of decisions at each injection point — is therefore a pure
+function of the seed and config: the interleaving of categories may
+vary with event-loop timing, but each category's Nth decision never
+does, and ``schedule_digest()`` captures the whole plan for equality
+checks.  Faults stop after ``max_faults`` fires so every campaign
+converges to a verifiable steady state.
+
+The hooks are duck-typed: ``ZKConnection`` reads ``client.faults``,
+``ZKServer``/``ReplicationService`` carry a ``faults`` slot.  With no
+injector installed every hook site is a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import random
+import struct
+
+from ..utils.aio import ambient_loop
+
+#: Decision streams, one seeded RNG each.  'plan' is reserved for the
+#: campaign driver's op/crash scheduling so workload choices never
+#: perturb transport-fault draws.
+CATEGORIES = ('connect', 'rx', 'tx', 'accept', 'server_tx',
+              'partition', 'plan')
+
+
+class InjectedRefusal(ConnectionRefusedError):
+    """A dial refused by the fault schedule (client side)."""
+
+
+@dataclasses.dataclass
+class FaultConfig:
+    """Probabilities and bounds for one campaign's fault mix.  All
+    probabilities are per-decision-point; delays are ms ranges."""
+
+    # client dial
+    p_connect_refuse: float = 0.0
+    connect_latency_ms: float = 0.0
+    # server -> client byte stream (client rx)
+    p_rx_reset: float = 0.0
+    p_rx_split: float = 0.0
+    p_rx_delay: float = 0.0
+    p_rx_dup: float = 0.0
+    rx_delay_ms: tuple[float, float] = (1.0, 25.0)
+    # client -> server byte stream (client tx)
+    p_tx_reset: float = 0.0
+    # server accept loop
+    p_accept_refuse: float = 0.0
+    # server reply/notification writes
+    p_server_tx_reset: float = 0.0
+    p_server_tx_split: float = 0.0
+    server_tx_delay_ms: tuple[float, float] = (0.0, 10.0)
+    # replication: leader -> follower push drop (asymmetric partition)
+    p_push_drop: float = 0.0
+    #: stop firing after this many injected faults (None = unbounded);
+    #: the budget is what makes randomized campaigns converge
+    max_faults: int | None = 8
+
+    @classmethod
+    def randomized(cls, seed: int) -> 'FaultConfig':
+        """A randomized-but-reproducible fault mix: which fault classes
+        are active, and how hard, is itself drawn from the seed."""
+        rng = random.Random('cfg/%d' % (seed,))
+        cfg = cls()
+        picks = rng.sample([
+            ('p_connect_refuse', 0.3), ('p_rx_reset', 0.08),
+            ('p_rx_split', 0.5), ('p_rx_delay', 0.4),
+            ('p_rx_dup', 0.06), ('p_tx_reset', 0.08),
+            ('p_accept_refuse', 0.3), ('p_server_tx_reset', 0.08),
+            ('p_server_tx_split', 0.5), ('p_push_drop', 0.3),
+        ], k=rng.randint(1, 4))
+        for name, ceil in picks:
+            setattr(cfg, name, rng.uniform(0.01, ceil))
+        cfg.connect_latency_ms = rng.choice([0.0, 0.0, 10.0, 50.0])
+        cfg.rx_delay_ms = (0.5, rng.uniform(2.0, 20.0))
+        cfg.server_tx_delay_ms = (0.0, rng.uniform(1.0, 8.0))
+        cfg.max_faults = rng.randint(1, 5)
+        return cfg
+
+
+class _Gate:
+    """Strictly-FIFO delayed delivery of byte segments to a sink.
+
+    TCP never reorders within a stream, so a delayed segment holds
+    everything behind it (slow delivery), it does not overtake.  A
+    ``reset`` sentinel queued behind segments delivers the prefix
+    first, then fires the reset callback — that is what makes injected
+    resets genuinely *mid-frame*."""
+
+    _RESET = object()
+
+    def __init__(self, sink, on_reset):
+        self._sink = sink
+        self._on_reset = on_reset
+        self._q: list = []       # (delay_ms, payload) pending delivery
+        self._timer = None
+        self.dead = False
+
+    @property
+    def pending(self) -> bool:
+        """True while segments are still queued or a delayed head is
+        waiting on its timer — later writes must queue behind them to
+        keep the stream FIFO."""
+        return bool(self._q) or self._timer is not None
+
+    def push(self, data: bytes, delay_ms: float = 0.0) -> None:
+        if self.dead:
+            return
+        self._q.append((delay_ms, data))
+        self._drain()
+
+    def push_reset(self) -> None:
+        if self.dead:
+            return
+        self._q.append((0.0, _Gate._RESET))
+        self._drain()
+
+    def _drain(self) -> None:
+        if self._timer is not None:
+            return                        # a delayed head is pending
+        while self._q and not self.dead:
+            delay_ms, payload = self._q[0]
+            if delay_ms > 0:
+                self._q[0] = (0.0, payload)
+
+                def fire():
+                    self._timer = None
+                    self._drain()
+                self._timer = ambient_loop().call_later(
+                    delay_ms / 1000.0, fire)
+                return
+            self._q.pop(0)
+            if payload is _Gate._RESET:
+                self.dead = True
+                self._q.clear()
+                self._on_reset()
+                return
+            self._sink(payload)
+
+    def close(self) -> None:
+        self.dead = True
+        self._q.clear()
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0,
+                 config: FaultConfig | None = None):
+        self.seed = seed
+        self.config = config if config is not None else FaultConfig()
+        self._streams = {cat: random.Random('%d/%s' % (seed, cat))
+                         for cat in CATEGORIES}
+        self.active = True
+        #: (category, description) of every fault actually fired —
+        #: printed on campaign failure next to the seed
+        self.fired: list[tuple[str, str]] = []
+        self._gates: list[_Gate] = []
+
+    # -- bookkeeping --
+
+    def _take(self, cat: str, p: float, desc: str) -> bool:
+        """One decision point: ALWAYS draws from the category stream
+        (so the schedule is a pure function of the seed regardless of
+        which faults are enabled), fires only while active and under
+        the fault budget."""
+        r = self._streams[cat].random()
+        if not self.active or p <= 0.0 or r >= p:
+            return False
+        if self.config.max_faults is not None and \
+                len(self.fired) >= self.config.max_faults:
+            return False
+        self.fired.append((cat, desc))
+        return True
+
+    def rand(self, cat: str) -> float:
+        return self._streams[cat].random()
+
+    def randint(self, cat: str, a: int, b: int) -> int:
+        return self._streams[cat].randint(a, b)
+
+    def choice(self, cat: str, seq):
+        return self._streams[cat].choice(seq)
+
+    def uniform(self, cat: str, a: float, b: float) -> float:
+        return self._streams[cat].uniform(a, b)
+
+    def stop(self) -> None:
+        """Stop injecting (verification phase).  Segments already in
+        flight through gates still deliver — they are real bytes."""
+        self.active = False
+
+    def close(self) -> None:
+        self.active = False
+        for g in self._gates:
+            g.close()
+        self._gates.clear()
+
+    def schedule_digest(self, per_category: int = 64) -> str:
+        """A digest of the fault plan: config + the first N draws of
+        every category stream.  Same seed + config => same digest,
+        independent of anything that happened at runtime."""
+        h = hashlib.sha256()
+        h.update(repr(dataclasses.astuple(self.config)).encode())
+        for cat in CATEGORIES:
+            rng = random.Random('%d/%s' % (self.seed, cat))
+            for _ in range(per_category):
+                h.update(struct.pack('<d', rng.random()))
+        return h.hexdigest()
+
+    @classmethod
+    def randomized(cls, seed: int) -> 'FaultInjector':
+        return cls(seed, FaultConfig.randomized(seed))
+
+    # -- client dial --
+
+    async def before_connect(self, backend_key: str) -> None:
+        """Called by the connection's dial task before the TCP connect:
+        sleeps the injected reconnect latency, then may refuse."""
+        refuse = self._take('connect', self.config.p_connect_refuse,
+                            'refuse dial to %s' % (backend_key,))
+        if self.config.connect_latency_ms > 0:
+            await asyncio.sleep(self.config.connect_latency_ms / 1000.0)
+        if refuse:
+            raise InjectedRefusal(
+                'injected connection refusal (%s)' % (backend_key,))
+
+    # -- client rx (server -> client bytes) --
+
+    def rx(self, conn, data: bytes) -> None:
+        """Route received bytes through the fault schedule, then on to
+        the connection's normal ``sockData`` path, in order."""
+        gate = getattr(conn, '_fault_rx_gate', None)
+        if gate is None or gate.dead:
+            def on_reset(c=conn):
+                c.emit('sockError', ConnectionResetError(
+                    'injected connection reset (rx)'))
+            gate = _Gate(lambda d, c=conn: c.emit('sockData', d),
+                         on_reset)
+            conn._fault_rx_gate = gate
+            self._gates.append(gate)
+        cfg = self.config
+        if self._take('rx', cfg.p_rx_reset, 'rx mid-frame reset'):
+            # deliver a strict prefix, then die: the codec is left
+            # holding a half frame when the teardown path runs
+            cut = self._streams['rx'].randrange(len(data)) \
+                if len(data) > 1 else 0
+            if cut:
+                gate.push(data[:cut])
+            gate.push_reset()
+            return
+        segments = [data]
+        if len(data) > 1 and self._take('rx', cfg.p_rx_split,
+                                        'rx split'):
+            cut = self._streams['rx'].randrange(1, len(data))
+            segments = [data[:cut], data[cut:]]
+        if self._take('rx', cfg.p_rx_dup, 'rx duplicate segment'):
+            segments.append(segments[self._streams['rx']
+                            .randrange(len(segments))])
+        lo, hi = cfg.rx_delay_ms
+        for seg in segments:
+            delay = 0.0
+            if self._take('rx', cfg.p_rx_delay, 'rx delay'):
+                delay = self._streams['rx'].uniform(lo, hi)
+            gate.push(seg, delay)
+
+    # -- client tx (client -> server bytes) --
+
+    def tx(self, conn, data: bytes) -> bytes | None:
+        """May truncate an outbound frame and schedule a reset; returns
+        the bytes to actually write (None = write nothing)."""
+        if self._take('tx', self.config.p_tx_reset,
+                      'tx mid-frame reset'):
+            cut = self._streams['tx'].randrange(len(data)) \
+                if len(data) > 1 else 0
+
+            def die(c=conn):
+                c.emit('sockError', ConnectionResetError(
+                    'injected connection reset (tx)'))
+            ambient_loop().call_soon(die)
+            return data[:cut] if cut else None
+        return data
+
+    # -- server side --
+
+    def accept_refuse(self) -> bool:
+        return self._take('accept', self.config.p_accept_refuse,
+                          'refuse accepted client')
+
+    def server_tx(self, server_conn, data: bytes) -> bool:
+        """Server-side write hook.  Returns True when the injector took
+        over delivery (split/delay/reset), False for pass-through."""
+        cfg = self.config
+        wants_reset = self._take('server_tx', cfg.p_server_tx_reset,
+                                 'server tx mid-frame reset')
+        wants_split = self._take('server_tx', cfg.p_server_tx_split,
+                                 'server tx split/delay')
+        gate = getattr(server_conn, '_fault_tx_gate', None)
+        if not (wants_reset or wants_split):
+            if gate is None or gate.dead or not gate.pending:
+                return False
+            # A delayed segment from an earlier write is still in the
+            # gate: this (un-faulted) write must queue behind it, or
+            # the stream would reorder in a way TCP never does.
+            gate.push(data)
+            return True
+        if gate is None or gate.dead:
+            def sink(d, c=server_conn):
+                if not c.closed:
+                    try:
+                        c.writer.write(d)
+                    except (ConnectionError, RuntimeError):
+                        pass
+
+            def on_reset(c=server_conn):
+                try:
+                    t = c.writer.transport
+                    if t is not None:
+                        t.abort()
+                except (ConnectionError, RuntimeError):
+                    pass
+                c.close()
+            gate = _Gate(sink, on_reset)
+            server_conn._fault_tx_gate = gate
+            self._gates.append(gate)
+        if wants_reset:
+            cut = self._streams['server_tx'].randrange(len(data)) \
+                if len(data) > 1 else 0
+            if cut:
+                gate.push(data[:cut])
+            gate.push_reset()
+            return True
+        cut = self._streams['server_tx'].randrange(1, len(data)) \
+            if len(data) > 1 else 0
+        lo, hi = cfg.server_tx_delay_ms
+        delay = self._streams['server_tx'].uniform(lo, hi)
+        if cut:
+            gate.push(data[:cut])
+            gate.push(data[cut:], delay)
+        else:
+            gate.push(data, delay)
+        return True
+
+    # -- replication partition --
+
+    def drop_push(self, follower_token: str) -> bool:
+        """Leader->follower push drop: the asymmetric half-partition
+        (the follower's control channel keeps working)."""
+        return self._take('partition', self.config.p_push_drop,
+                          'drop push to follower %s' % (follower_token,))
+
+
+# ---------------------------------------------------------------------
+# Campaign driver: one seeded schedule end to end.  Shared by
+# tests/test_chaos.py and the ``chaos`` CLI subcommand so the invariant
+# checks cannot diverge between them.
+# ---------------------------------------------------------------------
+
+#: Per-op deadline for campaign ops, ms.  Generous slack on top of this
+#: is what "bounded" is asserted against.
+CAMPAIGN_OP_DEADLINE_MS = 400
+#: Hard per-op bound: deadline plus scheduling slack.  An op neither
+#: completing nor raising inside this window is a violation ("silent
+#: hang").
+CAMPAIGN_OP_HARD_S = 4.0
+
+
+@dataclasses.dataclass
+class ScheduleResult:
+    seed: int
+    ops: int = 0
+    acked: int = 0
+    typed_errors: int = 0
+    deadline_errors: int = 0
+    faults: int = 0
+    watch_fires: int = 0
+    violations: list = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+async def run_schedule(seed: int, ops: int = 6) -> ScheduleResult:
+    """Run one seeded fault schedule against a fresh in-process server
+    and client; returns the invariant-check result.
+
+    Invariants asserted (violations listed in the result, seed
+    attached, so any failure is reproducible with the same seed):
+
+    - every client op completes or raises a *typed* error
+      (ZKError / ZKProtocolError, ZKDeadlineError included) within the
+      hard per-op bound — never a silent hang;
+    - no acked write is lost: an acked create (without a later acked
+      delete) exists with its data; an acked delete stays deleted; the
+      newest acked set is <= the server's final value (a later
+      *unacked* set may have applied — at-least-once ambiguity);
+    - no duplicated watch fire: no two dataChanged emits carry the
+      same mzxid.
+    """
+    from ..client import Client
+    from ..protocol.errors import ZKError, ZKProtocolError
+    from ..server.server import ZKServer
+    from ..server.store import ZKOpError
+    from .backoff import BackoffPolicy
+
+    inj = FaultInjector.randomized(seed)
+    res = ScheduleResult(seed=seed)
+    srv = await ZKServer().start()
+    srv.faults = inj
+    client = Client(
+        address='127.0.0.1', port=srv.port, session_timeout=3000,
+        seed=seed, faults=inj, op_timeout=CAMPAIGN_OP_DEADLINE_MS,
+        connect_policy=BackoffPolicy(timeout=400, retries=2,
+                                     delay=30, cap=200),
+        default_policy=BackoffPolicy(timeout=400, retries=3,
+                                     delay=50, cap=400))
+    client.start()
+
+    created: dict[str, bytes] = {}     # acked creates, path -> data
+    deleted: set[str] = set()          # acked deletes
+    last_acked_set = -1                # newest acked /w value index
+    fires: list[int] = []              # dataChanged mzxids
+
+    async def bounded(coro, what):
+        """Run one op under the hard bound; returns (ok, result)."""
+        try:
+            return True, await asyncio.wait_for(coro, CAMPAIGN_OP_HARD_S)
+        except (ZKError, ZKProtocolError) as e:
+            res.typed_errors += 1
+            if getattr(e, 'code', '') == 'DEADLINE_EXCEEDED':
+                res.deadline_errors += 1
+            return False, None
+        except asyncio.TimeoutError:
+            res.violations.append(
+                '%s hung past the %.1fs hard bound (deadline %d ms '
+                'never fired)' % (what, CAMPAIGN_OP_HARD_S,
+                                  CAMPAIGN_OP_DEADLINE_MS))
+            return False, None
+
+    try:
+        try:
+            await client.wait_connected(timeout=10, fail_fast=False)
+        except (asyncio.TimeoutError, TimeoutError):
+            res.violations.append(
+                'never connected within 10s (fault budget %r should '
+                'have exhausted)' % (inj.config.max_faults,))
+            return res
+
+        client.watcher('/w').on(
+            'dataChanged',
+            lambda data, stat: fires.append(stat.mzxid))
+
+        ok, _ = await bounded(client.create('/w', b'v0'), 'create /w')
+        if ok:
+            created['/w'] = b'v0'
+
+        set_idx = 0
+        for i in range(ops):
+            if not client.is_connected():
+                # A fault killed the connection: give the redial loop a
+                # bounded window so later ops exercise the *recovered*
+                # path too, not just fail-fast ZKNotConnectedError.
+                try:
+                    await client.wait_connected(timeout=1.0,
+                                                fail_fast=False)
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+            res.ops += 1
+            kind = inj.choice('plan', ('set', 'create', 'delete',
+                                       'get', 'list', 'sync'))
+            if kind == 'set':
+                set_idx += 1
+                ok, _ = await bounded(
+                    client.set('/w', b'v%d' % set_idx, version=-1),
+                    'set /w v%d' % set_idx)
+                if ok:
+                    res.acked += 1
+                    last_acked_set = set_idx
+            elif kind == 'create':
+                path, data = '/c%d' % i, b'd%d' % i
+                ok, _ = await bounded(client.create(path, data),
+                                      'create %s' % path)
+                if ok:
+                    res.acked += 1
+                    created[path] = data
+            elif kind == 'delete':
+                live = sorted(set(created) - deleted - {'/w'})
+                if not live:
+                    continue
+                path = inj.choice('plan', live)
+                ok, _ = await bounded(client.delete(path, -1),
+                                      'delete %s' % path)
+                if ok:
+                    res.acked += 1
+                    deleted.add(path)
+            elif kind == 'get':
+                await bounded(client.get('/w'), 'get /w')
+            elif kind == 'list':
+                await bounded(client.list('/'), 'list /')
+            else:
+                await bounded(client.sync('/w'), 'sync /w')
+
+        # -- verification: faults off, check the server's own tree --
+        inj.stop()
+        res.faults = len(inj.fired)
+
+        db = srv.db
+        for path, data in created.items():
+            if path in deleted:
+                continue
+            try:
+                got, _stat = db.get_data(path)
+            except ZKOpError:
+                res.violations.append(
+                    'acked create %s lost (NO_NODE after campaign)'
+                    % (path,))
+                continue
+            if path != '/w' and bytes(got) != data:
+                res.violations.append(
+                    'acked create %s holds %r, expected %r'
+                    % (path, bytes(got), data))
+        for path in deleted:
+            try:
+                db.get_data(path)
+                res.violations.append(
+                    'acked delete %s did not stick' % (path,))
+            except ZKOpError:
+                pass
+        if last_acked_set >= 0:
+            try:
+                got, _stat = db.get_data('/w')
+                idx = int(bytes(got)[1:])
+                if idx < last_acked_set:
+                    res.violations.append(
+                        'acked set v%d lost: /w holds %r'
+                        % (last_acked_set, bytes(got)))
+            except (ZKOpError, ValueError):
+                res.violations.append(
+                    'acked set v%d lost: /w unreadable'
+                    % (last_acked_set,))
+
+        res.watch_fires = len(fires)
+        dupes = [z for z in set(fires) if fires.count(z) > 1]
+        if dupes:
+            res.violations.append(
+                'duplicated watch fires for mzxid(s) %r' % (dupes,))
+        return res
+    finally:
+        try:
+            await asyncio.wait_for(client.close(), 5)
+        except (asyncio.TimeoutError, TimeoutError):
+            client.pool.stop()
+            res.violations.append('client.close() hung past 5s')
+        await srv.stop()
+        inj.close()
+
+
+async def run_campaign(base_seed: int, schedules: int,
+                       ops: int = 6,
+                       progress=None) -> list[ScheduleResult]:
+    """Run ``schedules`` consecutive seeded schedules starting at
+    ``base_seed``.  ``progress(result)`` is called after each one."""
+    out = []
+    for i in range(schedules):
+        r = await run_schedule(base_seed + i, ops=ops)
+        out.append(r)
+        if progress is not None:
+            progress(r)
+    return out

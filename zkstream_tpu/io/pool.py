@@ -24,26 +24,23 @@ module implements the same observable behavior directly:
 from __future__ import annotations
 
 import asyncio
-import dataclasses
 import random
 
 from ..utils.events import EventEmitter
 from ..utils.logging import Logger
+from .backoff import BackoffPolicy
 from .connection import Backend, ZKConnection
 from ..utils.aio import ambient_loop
 
+#: Back-compat alias: the reference's recovery objects carried fixed
+#: {timeout, retries, delay}; the same constructor calls now get capped
+#: exponential backoff + full jitter on the delay (io/backoff.py).
+RecoveryPolicy = BackoffPolicy
 
-@dataclasses.dataclass
-class RecoveryPolicy:
-    """Connect retry policy (reference: lib/client.js:96-107)."""
-
-    timeout: int = 5000
-    retries: int = 3
-    delay: int = 1000
-
-
-DEFAULT_CONNECT_POLICY = RecoveryPolicy(timeout=3000, retries=3, delay=500)
-DEFAULT_POLICY = RecoveryPolicy(timeout=5000, retries=3, delay=1000)
+DEFAULT_CONNECT_POLICY = BackoffPolicy(timeout=3000, retries=3,
+                                       delay=500, cap=5000)
+DEFAULT_POLICY = BackoffPolicy(timeout=5000, retries=3,
+                               delay=1000, cap=30000)
 
 #: How often to try moving back to a more-preferred backend, ms
 #: (reference: decoherenceInterval 600 s, lib/client.js:110-111).
@@ -52,8 +49,8 @@ DEFAULT_DECOHERENCE_INTERVAL = 600 * 1000
 
 class ConnectionPool(EventEmitter):
     def __init__(self, client, backends: list[Backend],
-                 connect_policy: RecoveryPolicy = DEFAULT_CONNECT_POLICY,
-                 default_policy: RecoveryPolicy = DEFAULT_POLICY,
+                 connect_policy: BackoffPolicy = DEFAULT_CONNECT_POLICY,
+                 default_policy: BackoffPolicy = DEFAULT_POLICY,
                  decoherence_interval: int = DEFAULT_DECOHERENCE_INTERVAL,
                  shuffle: bool = True, seed: int | None = None,
                  max_spares: int = 2):
@@ -68,6 +65,20 @@ class ConnectionPool(EventEmitter):
         self._connect_policy = connect_policy
         self._default_policy = default_policy
         self._decoherence_interval = decoherence_interval
+        #: Jitter stream for retry delays; derived from (not equal to)
+        #: the shuffle seed so seeding one does not couple the other.
+        self._jitter_seed = None if seed is None else seed ^ 0x5eed
+        #: Monitor-mode redial backoff: persists across dial cycles so
+        #: a long outage walks the delay up to the cap (storm
+        #: decorrelation) and resets only on a successful connect.
+        self._monitor_backoff = default_policy.backoff(self._jitter_seed)
+
+        #: Circuit-breaker flag: True from the moment the initial
+        #: retry policy exhausts on every backend ('failed' edge) until
+        #: the next successful connect.  Surfaced as the 'degraded' /
+        #: 'recovered' events here, re-emitted by the client, and read
+        #: by the client's zookeeper_degraded gauge.
+        self.degraded = False
 
         self.state = 'stopped'
         self.conn: ZKConnection | None = None
@@ -217,10 +228,23 @@ class ConnectionPool(EventEmitter):
         conn.connect()
         return await self._await_conn(conn, 'connected', timeout_ms)
 
+    def _note_connected(self) -> None:
+        """A connect landed: clear the failure latches and reset the
+        monitor backoff so the next outage starts from the base delay."""
+        self._failed_emitted = False
+        self._monitor_backoff.reset()
+        if self.degraded:
+            self.degraded = False
+            self.log.info('left degraded mode: backend reachable again')
+            self.emit('recovered')
+
     async def _dial_loop(self) -> None:
         """Keep one live connection.  The initial phase uses the connect
-        policy; once it exhausts on all backends, emit 'failed' and keep
-        dialing under the default policy (cueball monitor mode).
+        policy; once it exhausts on all backends, emit 'failed', enter
+        degraded mode, and keep dialing under the default policy
+        (cueball monitor mode).  All retry delays are capped-exponential
+        with full jitter (io/backoff.py) so a fleet of clients losing
+        the same backend does not redial in synchronized waves.
         Failover promotes a warm spare when one is parked — no fresh
         TCP dial."""
         policy = self._connect_policy
@@ -228,11 +252,12 @@ class ConnectionPool(EventEmitter):
             promoted = await self._promote_spare()
             if promoted is not None:
                 idx, conn = promoted
-                self._failed_emitted = False
+                self._note_connected()
                 await self._hold_connection(idx, conn)
                 policy = self._connect_policy
                 continue
             connected = False
+            attempt_backoff = policy.backoff(self._jitter_seed)
             for attempt in range(policy.retries):
                 for idx, backend in enumerate(self._backends):
                     if self._stopping:
@@ -240,14 +265,15 @@ class ConnectionPool(EventEmitter):
                     conn = await self._dial_one(backend, policy.timeout)
                     if conn is None:
                         continue
-                    self._failed_emitted = False
+                    self._note_connected()
                     connected = True
                     await self._hold_connection(idx, conn)
                     break
                 if connected:
                     break
                 if attempt + 1 < policy.retries:
-                    await asyncio.sleep(policy.delay / 1000.0)
+                    await asyncio.sleep(
+                        attempt_backoff.next_delay() / 1000.0)
             if connected:
                 # The connection (or its successor) died; dial again
                 # under the fresh-connect policy.
@@ -255,12 +281,15 @@ class ConnectionPool(EventEmitter):
                 continue
             if not self._failed_emitted:
                 self._failed_emitted = True
+                self.degraded = True
                 self._set_state('failed')
+                self.emit('degraded')
                 self.log.warning('failed to connect to any ZK backend '
                                  '(exhausted retry policy); entering '
-                                 'monitor mode')
+                                 'monitor mode (degraded)')
             policy = self._default_policy
-            await asyncio.sleep(policy.delay / 1000.0)
+            await asyncio.sleep(
+                self._monitor_backoff.next_delay() / 1000.0)
 
     async def _hold_connection(self, idx: int, conn: ZKConnection) -> None:
         """Park while a connection (or a rebalance successor) is live."""

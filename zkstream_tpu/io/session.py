@@ -20,10 +20,12 @@ import asyncio
 import time
 
 from ..protocol import consts
+from ..protocol.errors import ZKProtocolError
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
 from ..utils.metrics import Collector
+from .backoff import BackoffPolicy
 from .watcher import ZKWatcher
 
 METRIC_ZK_NOTIFICATION_COUNTER = 'zookeeper_notifications'
@@ -39,7 +41,9 @@ _NOTIFICATION_EVENTS = {
 
 class ZKSession(FSM):
     def __init__(self, timeout: int, collector: Collector | None = None,
-                 log: Logger | None = None):
+                 log: Logger | None = None,
+                 retry_policy: BackoffPolicy | None = None,
+                 seed: int | None = None):
         # Child logger; sessionId accretes once the server assigns one
         # (reference: lib/zk-session.js:42-44,179-181).
         self.log = Logger(log).child(component='ZKSession')
@@ -69,6 +73,14 @@ class ZKSession(FSM):
         #: :meth:`fatal_error`); None = loud default (loop exception
         #: handler after teardown).
         self.fatal_handler = None
+
+        #: SET_WATCHES re-arm retry backoff: the same jittered policy
+        #: object the pool redials under (shared via the client), so
+        #: reattach-time churn retries decorrelate the same way.
+        self._rearm_backoff = (retry_policy if retry_policy is not None
+                               else BackoffPolicy(delay=50,
+                                                  cap=2000)).backoff(seed)
+        self._rearm_handle: asyncio.TimerHandle | None = None
 
         super().__init__('detached')
 
@@ -339,6 +351,7 @@ class ZKSession(FSM):
             self.conn.destroy()
         self.conn = None
         self._cancel_expiry_timer()
+        self._cancel_rearm_retry()
         self.log.warning('ZK session expired')
 
     def state_closed(self, S) -> None:
@@ -346,6 +359,7 @@ class ZKSession(FSM):
             self.conn.destroy()
         self.conn = None
         self._cancel_expiry_timer()
+        self._cancel_rearm_retry()
         self.log.info('ZK session closed')
 
     # -- watcher plumbing --
@@ -408,12 +422,54 @@ class ZKSession(FSM):
 
         def done(err):
             if err is not None:
+                # Injected/real churn killed the SET_WATCHES round trip.
+                # The events stay in 'resuming' (they re-batch on the
+                # next reconnect), and — when the failure was transient
+                # and this connection is still serving — a jittered
+                # retry re-arms them without waiting for another
+                # disconnect.  Without this, watches could stay dark
+                # until the next unrelated reconnect: a dropped-event
+                # window.
                 self.log.warning('SET_WATCHES failed during watch '
                                  'resumption: %s', err)
+                self._schedule_rearm_retry()
                 return
+            self._rearm_backoff.reset()
             for event in all_evts:
                 event.resume()
-        self.conn.set_watches(events, zxid, done)
+        try:
+            self.conn.set_watches(events, zxid, done)
+        except ZKProtocolError as e:
+            # The connection died between 'connected' and this call
+            # (reattach churn): not a bug, the events stay 'resuming'
+            # and the retry path below re-arms them.
+            self.log.warning('connection lost before SET_WATCHES '
+                             'could be sent: %s', e)
+            self._schedule_rearm_retry()
+
+    def _schedule_rearm_retry(self) -> None:
+        """Retry :meth:`resume_watches` after a jittered backoff delay,
+        if the session is still attached over a usable connection by
+        then.  One timer at a time; re-arm churn cannot stack timers."""
+        if self._rearm_handle is not None:
+            return
+        delay_s = self._rearm_backoff.next_delay() / 1000.0
+        loop = asyncio.get_running_loop()
+
+        def fire():
+            self._rearm_handle = None
+            if not self.is_in_state('attached'):
+                return
+            conn = self.conn
+            if conn is None or not conn.is_in_state('connected'):
+                return
+            self.resume_watches()
+        self._rearm_handle = loop.call_later(delay_s, fire)
+
+    def _cancel_rearm_retry(self) -> None:
+        if self._rearm_handle is not None:
+            self._rearm_handle.cancel()
+            self._rearm_handle = None
 
     def watcher(self, path: str) -> ZKWatcher:
         """One cached ZKWatcher per path

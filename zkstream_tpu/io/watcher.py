@@ -32,6 +32,12 @@ import random
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
+from .backoff import BackoffPolicy
+
+#: Re-arm pacing after consecutive arm failures: base 5 ms doubling to
+#: a 500 ms cap — well below any session timeout, so a watch is never
+#: dark long, but enough to keep churn from spinning the FSM hot.
+ARM_RETRY_POLICY = BackoffPolicy(delay=5, cap=500, factor=2.0)
 
 #: Idle window after which an armed watch probes the server to check it
 #: has not missed a wakeup (reference: lib/zk-session.js:27-36).
@@ -129,7 +135,25 @@ class ZKWatchEvent(FSM):
         self.log = getattr(session, 'log', Logger()).child(
             component='ZKWatchEvent', path=path, event=evt)
         self.prev_zxid: int | None = None
+        #: Paces re-arm retries: under injected churn the arming read
+        #: can fail over and over while the session flaps between
+        #: attached and detached; without a growing delay the
+        #: wait_session -> wait_connected -> arming cycle becomes a
+        #: hot loop that floods the dying connection with re-arm
+        #: reads.  Shared jittered-backoff machinery (io/backoff.py);
+        #: ``_arm_retry`` is the "last attempt failed" latch.
+        self._arm_backoff = ARM_RETRY_POLICY.backoff()
+        self._arm_retry = False
+        #: True after 'deleted' was emitted for the node's current
+        #: absence: re-arming an existence watch on a still-missing
+        #: node (connection churn forces re-arms) must not re-emit
+        #: 'deleted' for the same deletion.
+        self._deleted_seen = False
         super().__init__('disarmed')
+
+    def _arm_ok(self) -> None:
+        self._arm_retry = False
+        self._arm_backoff.reset()
 
     def get_event(self) -> str:
         return self.evt
@@ -141,6 +165,12 @@ class ZKWatchEvent(FSM):
         """A matching notification arrived.  Only meaningful when armed
         or resuming; in other states we are already mid-(re)arm
         (reference: lib/zk-session.js:703-711)."""
+        # A server notification means the node genuinely changed, so
+        # the deleted-emit latch no longer describes the current
+        # absence: a create-then-delete pulse must re-report 'deleted'
+        # from the re-arm read (only *churn-forced* re-arms — which
+        # never come through here — stay suppressed).
+        self._deleted_seen = False
         if self.is_in_state('armed') or self.is_in_state('resuming'):
             self.emit('notifyAsserted')
 
@@ -181,12 +211,27 @@ class ZKWatchEvent(FSM):
             # (reference: lib/zk-session.js:781-790).
             S.immediate(lambda: S.goto_state('wait_session'))
             return
+        if self._arm_retry:
+            # Previous arming attempt(s) failed: pace the retry so
+            # connection churn cannot spin this FSM hot.  The timer is
+            # scope-bound — a disconnect mid-wait disposes it and the
+            # normal wait_session path takes over.
+            S.timeout(self._arm_backoff.next_delay(),
+                      lambda: S.goto_state('arming'))
+            return
         S.goto_state('arming')
 
     def state_arming(self, S) -> None:
         """Issue the read-with-watch; a valid reply (or certain errors)
         means the watch is armed (reference: lib/zk-session.js:803-888)."""
         conn = self.session.get_connection()
+        if conn is None or not conn.is_in_state('connected'):
+            # The connection died while a paced retry timer was
+            # pending (state_wait_connected's check is stale by the
+            # time the timer fires): back to waiting, don't throw.
+            self._arm_retry = True
+            S.immediate(lambda: S.goto_state('wait_session'))
+            return
         req = conn.request(self.to_packet())
 
         def on_reply(pkt):
@@ -205,6 +250,8 @@ class ZKWatchEvent(FSM):
             # Emit only if the relevant zxid moved since the last emit:
             # this suppresses duplicate notifications from the server
             # watch-kind overlap (reference: lib/zk-session.js:849-856).
+            self._arm_ok()
+            self._deleted_seen = False
             if self.prev_zxid is not None and zxid == self.prev_zxid:
                 S.goto_state('armed')
                 return
@@ -216,19 +263,27 @@ class ZKWatchEvent(FSM):
         def on_error(err, *a):
             code = getattr(err, 'code', None)
             if code == 'PING_TIMEOUT':
+                self._arm_retry = True
                 S.goto_state('wait_session')
                 return
             if self.evt == 'createdOrDeleted' and code == 'NO_NODE':
                 # Existence watches arm fine on a missing node
-                # (reference: lib/zk-session.js:865-874).
-                EventEmitter.emit(self.emitter, 'deleted')
+                # (reference: lib/zk-session.js:865-874).  Emit
+                # 'deleted' once per disappearance: churn-forced
+                # re-arms over the same absence stay silent.
+                self._arm_ok()
+                if not self._deleted_seen:
+                    self._deleted_seen = True
+                    EventEmitter.emit(self.emitter, 'deleted')
                 S.goto_state('armed')
                 return
             if code == 'NO_NODE':
                 # Other watch kinds cannot attach to a missing node;
                 # park until it is created.
+                self._arm_ok()
                 S.goto_state('wait_node')
                 return
+            self._arm_retry = True
             self.log.debug('watcher attach failure (%s); will retry',
                            err)
             S.goto_state('wait_session')

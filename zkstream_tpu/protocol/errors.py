@@ -31,6 +31,25 @@ class ZKPingTimeoutError(ZKProtocolError):
             'reply from ZK server')
 
 
+class ZKDeadlineError(ZKProtocolError):
+    """A client operation exceeded its per-request deadline.  Typed so
+    callers can distinguish "the connection is wedged / the server is
+    not answering" (retryable, outcome unknown) from a definite server
+    verdict; ``code`` is ``'DEADLINE_EXCEEDED'``."""
+
+    def __init__(self, opcode: str, path: str | None = None,
+                 deadline_ms: float | None = None):
+        where = ' %s' % (path,) if path else ''
+        after = '' if deadline_ms is None else ' after %d ms' \
+            % (deadline_ms,)
+        super().__init__('DEADLINE_EXCEEDED',
+            'Deadline exceeded%s waiting for %s%s reply'
+            % (after, opcode, where))
+        self.opcode = opcode
+        self.path = path
+        self.deadline_ms = deadline_ms
+
+
 class ZKNotConnectedError(ZKProtocolError):
     """An operation was attempted while no usable connection exists
     (reference: lib/errors.js:37-42)."""

@@ -119,6 +119,12 @@ class ReplicationService:
         #: same hazard ZKServer.stop() sorts around)
         self._writers: set[asyncio.StreamWriter] = set()
         self._subscribed = False
+        #: Optional seeded FaultInjector (io/faults.py): drops
+        #: leader->follower pushes to simulate an asymmetric partition
+        #: (the follower's control channel keeps flowing, so forwarded
+        #: writes still land while the event stream starves — the
+        #: piggyback/ack machinery is what must absorb the gap).
+        self.faults = None
 
     async def start(self) -> 'ReplicationService':
         self._server = await asyncio.start_server(
@@ -151,6 +157,14 @@ class ReplicationService:
 
     def _push(self, handle: _FollowerHandle, msg) -> None:
         if handle.writer is None:
+            return
+        if self.faults is not None and \
+                self.faults.drop_push(handle.token):
+            # Asymmetric partition: this push is lost.  For 'commit'
+            # pushes the shipped cursor still advances in
+            # _push_commits, exactly like bytes lost in the network —
+            # recovery rides the control channel's piggyback (acks
+            # gate the truncation floor, so no entry is lost).
             return
         try:
             handle.writer.write(_dump(msg))

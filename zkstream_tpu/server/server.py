@@ -56,6 +56,9 @@ class ServerConnection:
     def _write_bytes(self, data: bytes) -> None:
         if self.closed:
             return
+        fi = self.server.faults
+        if fi is not None and fi.server_tx(self, data):
+            return   # the injector took over delivery (split/delay/RST)
         try:
             self.writer.write(data)
         except (ConnectionError, RuntimeError):
@@ -373,6 +376,9 @@ class ZKServer:
         #: in-flight requests to hang until teardown).
         self.drop_pings = False
         self.drop_replies = False
+        #: Optional seeded FaultInjector (io/faults.py): accept-loop
+        #: refusals and reply-path splits/delays/mid-frame resets.
+        self.faults = None
         #: one-slot encode cache for notification fan-out
         #: ((type, path, zxid), wire bytes), filled via the dedicated
         #: connection-independent codec below (the bytes are shared
@@ -391,6 +397,14 @@ class ZKServer:
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        if self.faults is not None and self.faults.accept_refuse():
+            # Injected accept-loop refusal: the member is listening
+            # but sheds this client (overload / half-dead member).
+            try:
+                writer.transport.abort()
+            except (ConnectionError, RuntimeError):
+                pass
+            return
         conn = ServerConnection(self, reader, writer)
         self.conns.add(conn)
         await conn.run()
@@ -432,6 +446,12 @@ class ZKEnsemble:
                      store=None if i == 0 else ReplicaStore(self.db,
                                                             lag=lag))
             for i in range(count)]
+
+    def install_faults(self, injector) -> None:
+        """Install one seeded FaultInjector on every member (the chaos
+        campaign's server-side fault source)."""
+        for s in self.servers:
+            s.faults = injector
 
     def set_lag(self, idx: int, lag: float | None) -> None:
         """Change follower ``idx``'s replication lag (0 = synchronous,

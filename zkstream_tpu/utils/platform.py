@@ -20,8 +20,11 @@ def bounded_run(argv: list[str], budget_s: float,
                 env: dict | None = None) -> tuple[str, str, int]:
     """Run ``argv`` in its own process group with a hard budget;
     returns ``(status, detail, rc)`` where status is ``'ok'``
-    (exit 0), ``'error'`` (nonzero exit), or ``'timeout'`` (whole
-    group SIGKILLed after the budget; rc is -1).  With
+    (exit 0), ``'error'`` (nonzero exit), ``'killed'`` (the child
+    died on a signal — rc < 0 — which on a flaky accelerator tunnel
+    is an environmental event like a timeout, not a deterministic
+    program error), or ``'timeout'`` (whole group SIGKILLed after
+    the budget; rc is -1).  With
     ``capture_stderr``, stdout is discarded and detail carries the
     child's last stderr line on error — via a temp file, never a
     pipe, so a killed child (whose tunnel helpers may inherit the
@@ -63,6 +66,12 @@ def bounded_run(argv: list[str], budget_s: float,
             errf.seek(0)
             tail = errf.read().decode(errors='replace').strip()
             detail = (tail.splitlines()[-1:] or ['?'])[0]
+        if rc < 0:
+            # Signal-killed (OOM killer, tunnel-side abort, external
+            # kill): distinct from a deterministic nonzero exit so
+            # callers can retry it like a timeout instead of aborting
+            # the hunt (tools/tpu_window.py).
+            return 'killed', detail or ('signal %d' % (-rc,)), rc
         return 'error', detail, rc
 
 
