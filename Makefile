@@ -6,7 +6,7 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench asan chaos coverage clean
+.PHONY: all test check native bench asan chaos obs coverage clean
 
 all: check test
 
@@ -21,6 +21,13 @@ test: native
 chaos:
 	ZKSTREAM_CHAOS_SCHEDULES=$${ZKSTREAM_CHAOS_SCHEDULES:-60} \
 	    $(PYTHON) -m pytest tests/test_chaos.py -q -m 'not slow'
+
+# Observability suite: metrics (counters/gauges/histograms +
+# exposition), xid-correlated op tracing, and the four-letter admin
+# words (ruok/mntr/stat/srvr) — see README "Observability".
+obs:
+	$(PYTHON) -m pytest tests/test_metrics.py tests/test_trace.py \
+	    tests/test_admin_words.py -q
 
 check:
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)

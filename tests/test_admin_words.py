@@ -1,0 +1,192 @@
+"""Four-letter admin word tests (server/server.py): ruok / mntr /
+stat / srvr over raw TCP, like real ZooKeeper's — no length prefix,
+reply text, connection closed after the answer."""
+
+import asyncio
+
+from helpers import wait_until
+from zkstream_tpu import Client
+
+
+async def _four_letter(server, word: bytes) -> bytes:
+    reader, writer = await asyncio.open_connection('127.0.0.1',
+                                                   server.port)
+    try:
+        writer.write(word)
+        await writer.drain()
+        return await asyncio.wait_for(reader.read(), 5)
+    finally:
+        writer.close()
+
+
+async def test_ruok_returns_imok(server):
+    assert await _four_letter(server, b'ruok') == b'imok'
+
+
+async def test_mntr_reports_live_server_state(server):
+    """mntr over a live server with a connected client: znode count,
+    watch count, outstanding requests, and connection count are all
+    present and reflect reality."""
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/a', b'x')
+        await c.create('/a/b', b'y')
+        seen = []
+        c.watcher('/a').on('dataChanged',
+                           lambda d, s: seen.append(bytes(d)))
+        await wait_until(lambda: seen == [b'x'])
+
+        text = (await _four_letter(server, b'mntr')).decode()
+        kv = dict(line.split('\t', 1)
+                  for line in text.strip().splitlines())
+        # /, /a, /a/b
+        assert int(kv['zk_znode_count']) == 3
+        assert int(kv['zk_watch_count']) >= 1
+        assert int(kv['zk_outstanding_requests']) == 0
+        assert int(kv['zk_num_alive_connections']) >= 1
+        assert int(kv['zk_packets_received']) > 0
+        assert int(kv['zk_packets_sent']) > 0
+        assert int(kv['zk_sessions']) == 1
+        assert kv['zk_server_state'] == 'standalone'
+        assert kv['zk_zxid'].startswith('0x')
+    finally:
+        await c.close()
+
+
+async def test_stat_and_srvr_words(server):
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        stat = (await _four_letter(server, b'stat')).decode()
+        assert 'Zookeeper version:' in stat
+        assert 'Clients:' in stat
+        assert 'Mode: standalone' in stat
+        assert 'Node count: 1' in stat
+        # client lines carry the PEER address (the client's ephemeral
+        # port), not the server's own listening endpoint
+        sid = c.session.session_id
+        client_lines = [ln for ln in stat.splitlines()
+                        if ('sid=0x%x' % sid) in ln]
+        assert client_lines, stat
+        assert ':%d[' % server.port not in client_lines[0]
+        srvr = (await _four_letter(server, b'srvr')).decode()
+        assert 'Mode: standalone' in srvr
+        assert 'Clients:' not in srvr
+    finally:
+        await c.close()
+
+
+async def test_admin_word_split_across_segments(server):
+    """The four letters may straggle in over several TCP segments; the
+    server must buffer until it can decide."""
+    reader, writer = await asyncio.open_connection('127.0.0.1',
+                                                   server.port)
+    try:
+        writer.write(b'ru')
+        await writer.drain()
+        await asyncio.sleep(0.05)
+        writer.write(b'ok')
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(), 5) == b'imok'
+    finally:
+        writer.close()
+
+
+async def test_admin_probe_does_not_disturb_protocol_clients(server):
+    """Admin scrapes ride the same listener as protocol clients; a
+    client connected before and after a scrape keeps working."""
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/p', b'1')
+        assert await _four_letter(server, b'ruok') == b'imok'
+        data, _stat = await c.get('/p')
+        assert data == b'1'
+        await c.set('/p', b'2')
+        assert (await _four_letter(server, b'mntr')).startswith(
+            b'zk_version')
+    finally:
+        await c.close()
+
+
+async def test_mntr_follower_mode_in_ensemble():
+    from zkstream_tpu.server import ZKEnsemble
+
+    ens = await ZKEnsemble(2).start()
+    try:
+        leader = (await _four_letter(ens.servers[0], b'mntr')).decode()
+        follower = (await _four_letter(ens.servers[1],
+                                       b'mntr')).decode()
+        assert 'zk_server_state\tstandalone' in leader
+        assert 'zk_server_state\tfollower' in follower
+    finally:
+        await ens.stop()
+
+
+async def test_cli_mntr_subcommand(server, capsys):
+    from zkstream_tpu import cli
+
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:%d' % server.port, 'mntr'])
+    rc = await cli._admin(args)
+    out, _err = capsys.readouterr()
+    assert rc == 0
+    assert 'zk_znode_count\t1' in out
+
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:%d' % server.port, 'mntr', 'ruok'])
+    rc = await cli._admin(args)
+    out, _err = capsys.readouterr()
+    assert rc == 0 and out.strip() == 'imok'
+
+
+async def test_cli_mntr_scrapes_every_member(capsys):
+    """A multi-host --server list probes each member, not just the
+    first — that is what makes it an ensemble health check."""
+    from zkstream_tpu import cli
+    from zkstream_tpu.server import ZKEnsemble
+
+    ens = await ZKEnsemble(3).start()
+    try:
+        spec = ','.join('127.0.0.1:%d' % p
+                        for _h, p in ens.addresses())
+        args = cli.build_parser().parse_args(
+            ['--server', spec, 'mntr', 'ruok'])
+        rc = await cli._admin(args)
+        out, _err = capsys.readouterr()
+        assert rc == 0
+        assert out.count('imok') == 3
+        for _h, p in ens.addresses():
+            assert '--- 127.0.0.1:%d ---' % p in out
+    finally:
+        await ens.stop()
+
+
+async def test_cli_mntr_unreachable_is_exit_1(capsys):
+    from zkstream_tpu import cli
+
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:1', '--timeout', '2', 'mntr'])
+    rc = await cli._admin(args)
+    _out, err = capsys.readouterr()
+    assert rc == 1 and 'could not connect' in err
+
+
+async def test_cli_metrics_subcommand(server, capsys):
+    from zkstream_tpu import cli
+
+    args = cli.build_parser().parse_args(
+        ['--server', '127.0.0.1:%d' % server.port, 'metrics'])
+    rc = await cli._run(args)
+    out, _err = capsys.readouterr()
+    assert rc == 0
+    assert '# TYPE zookeeper_op_latency_ms histogram' in out
+    assert 'zookeeper_op_latency_ms_count{op="PING"} 1' in out
+    assert '# TYPE zkstream_fsm_transitions counter' in out

@@ -35,6 +35,7 @@ from zkstream_tpu.io.faults import (
     run_campaign,
 )
 from zkstream_tpu.server import ZKEnsemble, ZKServer
+from zkstream_tpu.utils.trace import format_spans
 
 BASE_SEED = int(os.environ.get('ZKSTREAM_CHAOS_SEED', '0'))
 SCHEDULES = int(os.environ.get('ZKSTREAM_CHAOS_SCHEDULES', '200'))
@@ -96,7 +97,9 @@ async def test_chaos_campaign(batch):
     bad = [r for r in results if not r.ok]
     assert not bad, 'chaos schedules failed; rerun any with ' \
         '`python -m zkstream_tpu chaos --seed N --schedules 1`:\n' + \
-        '\n'.join('seed %d: %s' % (r.seed, '; '.join(r.violations))
+        '\n'.join('seed %d: %s\n  span ring (oldest first):\n%s'
+                  % (r.seed, '; '.join(r.violations),
+                     format_spans(r.trace, limit=40))
                   for r in bad)
 
 

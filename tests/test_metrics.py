@@ -92,6 +92,160 @@ def test_gauge_callback_failure_does_not_sink_exposition():
     assert 'bad_gauge nan' in text
 
 
+def test_label_values_escaped_per_exposition_spec():
+    """A quote/backslash/newline in a label value must not produce
+    unparseable scrape text (a path label can carry any of them)."""
+    c = Collector()
+    ctr = c.counter('paths_total')
+    ctr.increment({'path': '/a"b\\c\nd'})
+    text = ctr.expose()
+    assert 'paths_total{path="/a\\"b\\\\c\\nd"} 1.0' in text
+    # and the same escaping on histogram series
+    h = c.histogram('lat_ms', buckets=(1.0,))
+    h.observe(0.5, {'path': 'x"y'})
+    assert 'lat_ms_bucket{path="x\\"y",le="1"} 1' in h.expose()
+
+
+def test_get_collector_unknown_name_is_a_clear_error():
+    c = Collector()
+    c.counter('known_counter')
+    with pytest.raises(ValueError) as ei:
+        c.get_collector('nope_metric')
+    assert 'nope_metric' in str(ei.value)
+    assert 'known_counter' in str(ei.value)
+
+
+def test_histogram_bucket_inf_sum_count_semantics():
+    """_bucket series are cumulative with a +Inf catch-all; _sum and
+    _count aggregate every observation including over-the-top ones."""
+    from zkstream_tpu.utils.metrics import Histogram
+
+    h = Histogram('lat_ms', 'latency', buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 5.0, 50.0, 5000.0):
+        h.observe(v, {'op': 'GET'})
+    assert h.count({'op': 'GET'}) == 5
+    assert h.sum({'op': 'GET'}) == 0.5 + 5.0 + 5.0 + 50.0 + 5000.0
+    assert h.bucket_value(1.0, {'op': 'GET'}) == 1
+    assert h.bucket_value(10.0, {'op': 'GET'}) == 3
+    assert h.bucket_value(100.0, {'op': 'GET'}) == 4
+    assert h.bucket_value(float('inf'), {'op': 'GET'}) == 5
+    text = h.expose()
+    assert '# TYPE lat_ms histogram' in text
+    assert 'lat_ms_bucket{op="GET",le="1"} 1' in text
+    assert 'lat_ms_bucket{op="GET",le="10"} 3' in text
+    assert 'lat_ms_bucket{op="GET",le="100"} 4' in text
+    assert 'lat_ms_bucket{op="GET",le="+Inf"} 5' in text
+    assert 'lat_ms_count{op="GET"} 5' in text
+    assert 'lat_ms_sum{op="GET"} 5060.5' in text
+    # unlabelled series are independent
+    h.observe(2.0)
+    assert h.count() == 1 and h.count({'op': 'GET'}) == 5
+
+
+def test_collector_histogram_idempotent_and_collision_checked():
+    c = Collector()
+    h = c.histogram('lat_ms')
+    assert c.histogram('lat_ms') is h
+    assert c.get_collector('lat_ms') is h
+    with pytest.raises(ValueError):
+        c.counter('lat_ms')
+    with pytest.raises(ValueError):
+        c.gauge('lat_ms', lambda: 0)
+    # re-registering with different bounds would silently mis-bucket
+    # the second registrant's observations — it must raise instead
+    with pytest.raises(ValueError) as ei:
+        c.histogram('lat_ms', buckets=(1.0, 2.0))
+    assert 'lat_ms' in str(ei.value)
+
+
+async def test_client_per_op_latency_histograms(server):
+    """Every client op records into zookeeper_op_latency_ms, labelled
+    by opcode, with coherent _bucket/_sum/_count series."""
+    coll = Collector()
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, collector=coll)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/h', b'v')
+        await c.get('/h')
+        await c.get('/h')
+        await c.set('/h', b'w')
+        await c.list('/')
+        await c.ping()
+        h = coll.get_collector('zookeeper_op_latency_ms')
+        assert h.count({'op': 'CREATE'}) == 1
+        assert h.count({'op': 'GET_DATA'}) == 2
+        assert h.count({'op': 'SET_DATA'}) == 1
+        assert h.count({'op': 'GET_CHILDREN2'}) == 1
+        assert h.count({'op': 'PING'}) == 1
+        assert h.sum({'op': 'GET_DATA'}) > 0
+        text = coll.expose()
+        assert 'zookeeper_op_latency_ms_bucket{op="CREATE",le="+Inf"} 1' \
+            in text
+        assert 'zookeeper_op_latency_ms_count{op="GET_DATA"} 2' in text
+        # connect+handshake latency landed too
+        ch = coll.get_collector('zookeeper_connect_latency_ms')
+        assert ch.count({'backend': '127.0.0.1:%d' % server.port}) >= 1
+    finally:
+        await c.close()
+
+
+async def test_fsm_transition_metrics_and_state_gauge(server):
+    """Every FSM (client/connection/session/pool) feeds the shared
+    transition counter and the live current-state gauge."""
+    coll = Collector()
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, collector=coll)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        ctr = coll.get_collector('zkstream_fsm_transitions')
+        assert ctr.value({'fsm': 'ZKConnection',
+                          'from': 'handshaking',
+                          'to': 'connected'}) >= 1
+        assert ctr.value({'fsm': 'ZKSession', 'from': 'attaching',
+                          'to': 'attached'}) == 1
+        # the pool flips to 'running' on the dial task's next wakeup,
+        # which may trail the client's 'connect' emission by a tick
+        from helpers import wait_until
+        await wait_until(lambda: ctr.value(
+            {'fsm': 'ConnectionPool', 'from': 'starting',
+             'to': 'running'}) == 1)
+        text = coll.expose()
+        assert 'zkstream_fsm_state{fsm="ZKSession",state="attached"} ' \
+            '1.0' in text
+        assert 'zkstream_fsm_state{fsm="ZKClient",state="normal"} 1.0' \
+            in text
+    finally:
+        await c.close()
+    # after close, the census reflects the terminal states
+    text = coll.expose()
+    assert 'zkstream_fsm_state{fsm="ZKClient",state="closed"} 1.0' \
+        in text
+
+
+async def test_scrape_after_chaos_schedule_smoke():
+    """One seeded chaos schedule with an injected collector: the
+    post-campaign scrape must expose cleanly — no NaN gauges, and
+    every registered histogram readable with >= 0 samples."""
+    from zkstream_tpu.io.faults import run_schedule
+
+    coll = Collector()
+    res = await run_schedule(17, ops=4, collector=coll)
+    assert res.ok, res.violations
+    text = coll.expose()
+    assert ' nan' not in text
+    hists = coll.histograms()
+    assert any(h.name == 'zookeeper_op_latency_ms' for h in hists)
+    for h in hists:
+        for key in list(h._series) or [()]:
+            assert h.count(dict(key)) >= 0
+    # ops ran, so per-op latency actually observed samples
+    assert coll.get_collector('zookeeper_op_latency_ms').count(
+        {'op': 'CREATE'}) >= 1
+
+
 def test_gauge_name_collision_raises():
     """Silently replacing a gauge would drop the first registrant's
     series; two ingests sharing a collector use distinct prefixes."""

@@ -1,0 +1,148 @@
+"""Op-tracing tests (utils/trace.py): span lifecycle, xid/zxid
+correlation through the connection, the bounded ring, and the chaos
+campaign's failure dump."""
+
+import json
+
+import pytest
+
+from helpers import wait_until
+from zkstream_tpu import Client, ZKDeadlineError
+from zkstream_tpu.utils.trace import TraceRing, format_spans
+
+
+def test_ring_is_bounded_and_ordered():
+    ring = TraceRing(capacity=4)
+    for i in range(10):
+        ring.start('OP%d' % i).finish(zxid=i)
+    assert len(ring) == 4
+    dump = ring.dump()
+    assert [s['op'] for s in dump] == ['OP6', 'OP7', 'OP8', 'OP9']
+    assert all(s['status'] == 'ok' for s in dump)
+    # dumps are JSON-ready
+    json.loads(ring.dump_json())
+    ring.clear()
+    assert len(ring) == 0
+
+
+def test_span_double_finish_keeps_first_outcome():
+    ring = TraceRing()
+    span = ring.start('GET_DATA', '/x')
+    span.finish(zxid=7, status='ok')
+    span.finish(status='error', error='CONNECTION_LOSS')
+    d = span.to_dict()
+    assert d['status'] == 'ok' and d['zxid'] == 7
+    assert 'error' not in d
+
+
+def test_format_spans_is_readable_and_bounded():
+    ring = TraceRing()
+    for i in range(6):
+        ring.start('CREATE', '/n%d' % i).finish(zxid=i)
+    text = format_spans(ring.dump(), limit=3)
+    assert text.count('\n') == 2          # 3 lines
+    assert 'CREATE' in text and '/n5' in text
+
+
+async def test_client_spans_are_xid_and_zxid_correlated(server):
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/t', b'a')
+        await c.set('/t', b'b')
+        await c.get('/t')
+        spans = {s['op']: s for s in c.trace.dump()}
+        create, st, get = (spans['CREATE'], spans['SET_DATA'],
+                           spans['GET_DATA'])
+        # xids are the connection's, strictly increasing per request
+        assert 0 < create['xid'] < st['xid'] < get['xid']
+        # replies stamped each span with the server's zxid
+        assert create['zxid'] == 1 and st['zxid'] == 2
+        assert get['zxid'] == 2              # reads carry head zxid
+        for s in (create, st, get):
+            assert s['status'] == 'ok'
+            assert s['duration_ms'] >= 0
+            assert s['backend'] == '127.0.0.1:%d' % server.port
+            assert s['session_id'] == c.session.get_session_id()
+    finally:
+        await c.close()
+
+
+async def test_error_and_deadline_spans(server):
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        with pytest.raises(Exception):
+            await c.get('/missing')
+        err_span = [s for s in c.trace.dump()
+                    if s['op'] == 'GET_DATA'][-1]
+        assert err_span['status'] == 'error'
+        assert err_span['error'] == 'NO_NODE'
+
+        await c.create('/d', b'x')
+        server.drop_replies = True
+        with pytest.raises(ZKDeadlineError):
+            await c.get('/d', deadline=150)
+        dl_span = [s for s in c.trace.dump()
+                   if s['op'] == 'GET_DATA'][-1]
+        assert dl_span['status'] == 'deadline'
+        assert dl_span['error'] == 'DEADLINE_EXCEEDED'
+    finally:
+        server.drop_replies = False
+        await c.close()
+
+
+async def test_notifications_recorded_in_ring(server):
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/n', b'a')
+        seen = []
+        c.watcher('/n').on('dataChanged',
+                           lambda d, s: seen.append(bytes(d)))
+        await wait_until(lambda: seen == [b'a'])
+        await c.set('/n', b'b')
+        await wait_until(lambda: seen == [b'a', b'b'])
+        notifs = [s for s in c.trace.dump()
+                  if s['kind'] == 'notification']
+        assert notifs and notifs[-1]['path'] == '/n'
+        # stamped with the session's last-tracked zxid at delivery
+        # (the notification may outrun the write reply's zxid)
+        assert notifs[-1]['zxid'] >= 1
+    finally:
+        await c.close()
+
+
+async def test_injected_ring_and_capacity(server):
+    ring = TraceRing(capacity=3)
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000, trace=ring)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        assert c.trace is ring
+        for i in range(5):
+            await c.create('/r%d' % i, b'x')
+        assert len(ring) == 3
+        assert [s['path'] for s in ring.dump()] == ['/r2', '/r3', '/r4']
+    finally:
+        await c.close()
+
+
+async def test_chaos_schedule_result_carries_trace():
+    """Every chaos schedule result ships its span dump — the substrate
+    for the on-failure print in tests/test_chaos.py and the chaos CLI
+    (which adds --trace-out for offline triage)."""
+    from zkstream_tpu.io.faults import run_schedule
+
+    res = await run_schedule(5, ops=3)
+    assert res.trace, 'span ring dump missing from schedule result'
+    assert any(s['op'] == 'CREATE' for s in res.trace)
+    json.dumps(res.trace)          # JSON-ready for --trace-out
+    assert format_spans(res.trace)  # and renderable for failures

@@ -43,4 +43,5 @@ from .protocol.errors import (  # noqa: F401
 )
 from .protocol.records import ACL, OPEN_ACL_UNSAFE, Id, Stat  # noqa: F401
 from .utils.logging import Logger  # noqa: F401
-from .utils.metrics import Collector  # noqa: F401
+from .utils.metrics import Collector, Histogram  # noqa: F401
+from .utils.trace import TraceRing  # noqa: F401

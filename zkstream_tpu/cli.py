@@ -176,6 +176,12 @@ async def _dispatch(client: Client, args) -> int:
         await client.delete(args.path, args.version)
     elif cmd == 'sync':
         await client.sync(args.path)
+    elif cmd == 'metrics':
+        # one ping so the scrape is never empty of samples, then the
+        # client collector's full Prometheus exposition (per-op
+        # latency histograms, FSM transition counters, gauges)
+        await client.ping()
+        print(client.collector.expose())
     elif cmd == 'watch':
         return await _watch(client, args)
     else:  # pragma: no cover - argparse enforces choices
@@ -271,6 +277,19 @@ def build_parser() -> argparse.ArgumentParser:
     sy = sub.add_parser('sync', help='sync a path with the leader')
     sy.add_argument('path')
 
+    mn = sub.add_parser(
+        'mntr',
+        help='scrape a live server with a ZooKeeper four-letter '
+             'admin word (raw TCP, no session)')
+    mn.add_argument('word', nargs='?', default='mntr',
+                    choices=('mntr', 'ruok', 'stat', 'srvr'),
+                    help='which admin word to send (default mntr)')
+
+    sub.add_parser(
+        'metrics',
+        help='connect, ping once, and print the client collector '
+             'in Prometheus exposition format')
+
     wa = sub.add_parser('watch', help='stream watch events for a path')
     wa.add_argument('path')
     wa.add_argument('--count', '-n', type=int, default=0,
@@ -288,14 +307,59 @@ def build_parser() -> argparse.ArgumentParser:
                     help='client ops per schedule')
     ch.add_argument('--quiet', action='store_true',
                     help='only print failing schedules + the summary')
+    ch.add_argument('--trace-out', metavar='PATH', default=None,
+                    help='write every schedule\'s xid-correlated span '
+                         'dump as JSON to PATH for offline triage')
     return p
+
+
+async def _admin_one(host: str, port: int, word: str,
+                     timeout: float) -> bytes:
+    """One raw four-letter-word round trip; raises OSError/timeout."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout)
+    try:
+        writer.write(word.encode('ascii'))
+        await writer.drain()
+        return await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+
+
+async def _admin(args) -> int:
+    """Send one four-letter admin word over raw TCP (no ZK session)
+    to EVERY server in --server — an ensemble health probe scrapes
+    each member, it does not stop at the first — and print the
+    replies (prefixed by member when more than one).  Exit 0 when all
+    answered, 1 when any was unreachable."""
+    failed = 0
+    many = len(args.server) > 1
+    for spec in args.server:
+        host, port = spec['address'], spec['port']
+        if many:
+            print('--- %s:%d ---' % (host, port))
+        try:
+            data = await _admin_one(host, port, args.word,
+                                    args.timeout)
+        except (OSError, asyncio.TimeoutError, TimeoutError):
+            print('error: could not connect to %s:%d' % (host, port),
+                  file=sys.stderr)
+            failed += 1
+            continue
+        sys.stdout.write(data.decode('utf-8', 'replace'))
+        if data and not data.endswith(b'\n'):
+            sys.stdout.write('\n')
+    return 1 if failed else 0
 
 
 async def _chaos(args) -> int:
     """Drive the seeded chaos campaign (io/faults.py) and report.
     Exit 0 when every schedule's invariants held, 1 otherwise; each
-    line carries the seed, so any failure reruns with --seed N."""
+    line carries the seed, so any failure reruns with --seed N — and
+    arrives with its xid-correlated span dump (utils/trace.py), so
+    the failing interleaving is visible without log grepping."""
     from .io.faults import run_campaign
+    from .utils.trace import format_spans
 
     def progress(r):
         if args.quiet and r.ok:
@@ -307,9 +371,19 @@ async def _chaos(args) -> int:
                  r.deadline_errors, r.faults, r.watch_fires))
         for v in r.violations:
             print('    violation: %s' % (v,))
+        if not r.ok and r.trace:
+            print('  span ring (oldest first):')
+            print(format_spans(r.trace))
 
     results = await run_campaign(args.seed, args.schedules,
                                  ops=args.ops, progress=progress)
+    if args.trace_out:
+        import json
+        with open(args.trace_out, 'w') as f:
+            json.dump([{'seed': r.seed, 'ok': r.ok,
+                        'violations': r.violations, 'trace': r.trace}
+                       for r in results], f, indent=2)
+        print('span dumps written to %s' % (args.trace_out,))
     bad = [r for r in results if not r.ok]
     print('%d/%d schedules ok (%d faults injected, %d typed errors, '
           '%d deadline errors)'
@@ -330,6 +404,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == 'chaos':
         # chaos runs its own in-process servers; no --server dial.
         return asyncio.run(_chaos(args))
+    if args.cmd == 'mntr':
+        # raw four-letter-word scrape: no client, no session
+        return asyncio.run(_admin(args))
     return asyncio.run(_run(args))
 
 

@@ -24,6 +24,7 @@ Usage::
 from __future__ import annotations
 
 import asyncio
+import time
 
 from .io.backoff import BackoffPolicy
 from .io.connection import Backend, ZKConnection
@@ -39,12 +40,14 @@ from .protocol.consts import CreateFlag
 from .protocol.errors import ZKDeadlineError, ZKNotConnectedError
 from .protocol.records import OPEN_ACL_UNSAFE, Stat
 from .utils.aio import ambient_loop
-from .utils.fsm import FSM
+from .utils.fsm import FSM, bind_transition_metrics
 from .utils.logging import Logger
 from .utils.metrics import Collector
+from .utils.trace import TraceRing
 
 METRIC_ZK_EVENT_COUNTER = 'zookeeper_events'
 METRIC_ZK_DEGRADED_GAUGE = 'zookeeper_degraded'
+METRIC_ZK_OP_LATENCY = 'zookeeper_op_latency_ms'
 
 #: Default session timeout, ms (reference: lib/client.js:80-83).
 DEFAULT_SESSION_TIMEOUT = 30000
@@ -76,7 +79,9 @@ class Client(FSM):
                  on_fatal=None,
                  max_spares: int = 2,
                  op_timeout: int | None = DEFAULT_OP_TIMEOUT,
-                 faults=None):
+                 faults=None,
+                 trace: TraceRing | None = None,
+                 trace_capacity: int = 256):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -122,6 +127,17 @@ class Client(FSM):
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
             'Total number of zookeeper events')
+        #: Per-op latency distribution, labelled by opcode; recorded by
+        #: _await_op on every completion path (ok, error, deadline).
+        self._op_latency = self.collector.histogram(
+            METRIC_ZK_OP_LATENCY,
+            'Client op round-trip latency, milliseconds, by opcode')
+        #: Bounded in-memory span ring (utils/trace.py): one span per
+        #: op, xid-correlated through the connection and stamped with
+        #: the reply zxid.  Injectable so chaos campaigns and tests can
+        #: dump it on failure.
+        self.trace = trace if trace is not None else TraceRing(
+            trace_capacity)
 
         self.session_timeout = session_timeout
         self.session: ZKSession | None = None
@@ -153,6 +169,13 @@ class Client(FSM):
             # Shared collector across clients: the first registrant's
             # pool owns the series.
             pass
+
+        # FSM observability (utils/fsm.py): transition counters + a
+        # live current-state gauge for the client machine and the pool;
+        # the session and every connection bind themselves.
+        self.bind_fsm_metrics(self.collector, 'ZKClient')
+        bind_transition_metrics(self.pool, self.collector,
+                                'ConnectionPool')
 
         self._started = False
         super().__init__('normal')
@@ -213,7 +236,8 @@ class Client(FSM):
         if not self.is_in_state('normal'):
             return
         s = ZKSession(self.session_timeout, self.collector, log=self.log,
-                      retry_policy=self._retry_policy, seed=self._seed)
+                      retry_policy=self._retry_policy, seed=self._seed,
+                      trace=self.trace)
         s.fatal_handler = self.on_fatal
         self.session = s
 
@@ -378,8 +402,22 @@ class Client(FSM):
 
     # -- operations (reference: lib/client.js:318-601) --
 
+    def _start_op(self, conn: ZKConnection, pkt: dict) -> tuple:
+        """Send one traced request: the span is created before the
+        write, correlated by the xid the connection assigns, and closed
+        by the connection's reply/error routing (io/connection.py) with
+        the reply zxid stamped on."""
+        span = self.trace.start(pkt['opcode'], pkt.get('path'))
+        req = conn.request(pkt)
+        span.xid = pkt['xid']
+        span.backend = conn.backend.key
+        if conn.session is not None:
+            span.session_id = conn.session.get_session_id()
+        req.span = span
+        return req.as_future(), span
+
     async def _await_op(self, fut: asyncio.Future, opcode: str,
-                        path: str | None, deadline) -> dict:
+                        path: str | None, deadline, span=None) -> dict:
         """Bound one request future by the per-request deadline.
 
         ``deadline`` is the per-op override in ms (``_USE_DEFAULT`` =
@@ -387,48 +425,67 @@ class Client(FSM):
         the op fails fast with a typed :class:`ZKDeadlineError` instead
         of hanging on a dead or wedged connection; the underlying
         request is cancelled for the caller, and the connection's
-        teardown paths still settle it exactly once internally."""
+        teardown paths still settle it exactly once internally.
+
+        Every completion path (reply, error, deadline) records the
+        elapsed time into the per-op latency histogram."""
         ms = self.op_timeout if deadline is _USE_DEFAULT else deadline
-        if ms is None:
-            return await fut
+        t0 = time.monotonic()
         try:
-            return await asyncio.wait_for(fut, ms / 1000.0)
-        except asyncio.TimeoutError:
-            raise ZKDeadlineError(opcode, path, ms) from None
+            if ms is None:
+                return await fut
+            try:
+                return await asyncio.wait_for(fut, ms / 1000.0)
+            except asyncio.TimeoutError:
+                if span is not None:
+                    span.finish(status='deadline',
+                                error='DEADLINE_EXCEEDED')
+                raise ZKDeadlineError(opcode, path, ms) from None
+        finally:
+            self._op_latency.observe(
+                (time.monotonic() - t0) * 1000.0, {'op': opcode})
 
     async def ping(self, deadline=_USE_DEFAULT) -> float:
         """Round-trip a ping; resolves to the latency in ms."""
         conn = self._conn_or_raise()
         loop = ambient_loop()
         fut: asyncio.Future = loop.create_future()
+        span = self.trace.start('PING')
+        span.backend = conn.backend.key
 
         def cb(err, latency):
             if fut.done():
                 return
             if err is not None:
+                span.finish(status='error',
+                            error=getattr(err, 'code', None)
+                            or type(err).__name__)
                 fut.set_exception(err)
             else:
+                span.finish()
                 fut.set_result(latency)
         conn.ping(cb)
-        return await self._await_op(fut, 'PING', None, deadline)
+        return await self._await_op(fut, 'PING', None, deadline, span)
 
     async def list(self, path: str,
                    deadline=_USE_DEFAULT) -> tuple[list[str], Stat]:
         """Children of a znode, with its stat."""
         self._check_path(path)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'GET_CHILDREN2', 'path': path,
-                            'watch': False}).as_future()
-        pkt = await self._await_op(fut, 'GET_CHILDREN2', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'GET_CHILDREN2',
+                                          'path': path, 'watch': False})
+        pkt = await self._await_op(fut, 'GET_CHILDREN2', path, deadline,
+                                   span)
         return pkt['children'], pkt['stat']
 
     async def get(self, path: str,
                   deadline=_USE_DEFAULT) -> tuple[bytes, Stat]:
         self._check_path(path)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'GET_DATA', 'path': path,
-                            'watch': False}).as_future()
-        pkt = await self._await_op(fut, 'GET_DATA', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'GET_DATA',
+                                          'path': path, 'watch': False})
+        pkt = await self._await_op(fut, 'GET_DATA', path, deadline,
+                                   span)
         return pkt['data'], pkt['stat']
 
     async def create(self, path: str, data: bytes,
@@ -441,10 +498,11 @@ class Client(FSM):
         if acl is None:
             acl = list(OPEN_ACL_UNSAFE)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'CREATE', 'path': path,
-                            'data': data, 'acl': acl,
-                            'flags': CreateFlag(flags)}).as_future()
-        pkt = await self._await_op(fut, 'CREATE', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'CREATE',
+                                          'path': path, 'data': data,
+                                          'acl': acl,
+                                          'flags': CreateFlag(flags)})
+        pkt = await self._await_op(fut, 'CREATE', path, deadline, span)
         return pkt['path']
 
     async def create_with_empty_parents(self, path: str, data: bytes,
@@ -486,10 +544,11 @@ class Client(FSM):
         self._check_data(data)
         self._check_version(version)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'SET_DATA', 'path': path,
-                            'data': data,
-                            'version': version}).as_future()
-        pkt = await self._await_op(fut, 'SET_DATA', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'SET_DATA',
+                                          'path': path, 'data': data,
+                                          'version': version})
+        pkt = await self._await_op(fut, 'SET_DATA', path, deadline,
+                                   span)
         return pkt['stat']
 
     async def delete(self, path: str, version: int,
@@ -497,24 +556,25 @@ class Client(FSM):
         self._check_path(path)
         self._check_version(version)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'DELETE', 'path': path,
-                            'version': version}).as_future()
-        await self._await_op(fut, 'DELETE', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'DELETE',
+                                          'path': path,
+                                          'version': version})
+        await self._await_op(fut, 'DELETE', path, deadline, span)
 
     async def stat(self, path: str, deadline=_USE_DEFAULT) -> Stat:
         self._check_path(path)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'EXISTS', 'path': path,
-                            'watch': False}).as_future()
-        pkt = await self._await_op(fut, 'EXISTS', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'EXISTS',
+                                          'path': path, 'watch': False})
+        pkt = await self._await_op(fut, 'EXISTS', path, deadline, span)
         return pkt['stat']
 
     async def get_acl(self, path: str, deadline=_USE_DEFAULT):
         self._check_path(path)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'GET_ACL',
-                            'path': path}).as_future()
-        pkt = await self._await_op(fut, 'GET_ACL', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'GET_ACL',
+                                          'path': path})
+        pkt = await self._await_op(fut, 'GET_ACL', path, deadline, span)
         return pkt['acl']
 
     async def sync(self, path: str, deadline=_USE_DEFAULT) -> None:
@@ -522,8 +582,9 @@ class Client(FSM):
         (reference: lib/client.js:578-597)."""
         self._check_path(path)
         conn = self._conn_or_raise()
-        fut = conn.request({'opcode': 'SYNC', 'path': path}).as_future()
-        await self._await_op(fut, 'SYNC', path, deadline)
+        fut, span = self._start_op(conn, {'opcode': 'SYNC',
+                                          'path': path})
+        await self._await_op(fut, 'SYNC', path, deadline, span)
 
     def watcher(self, path: str) -> ZKWatcher:
         self._check_path(path)

@@ -28,6 +28,8 @@ from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
 
+METRIC_ZK_CONNECT_LATENCY = 'zookeeper_connect_latency_ms'
+
 
 @dataclasses.dataclass(frozen=True)
 class Backend:
@@ -41,13 +43,28 @@ class Backend:
         return '%s:%d' % (self.address, self.port)
 
 
+def _finish_span(req, zxid: int | None = None, status: str = 'ok',
+                 error: str | None = None) -> None:
+    """Close a request's trace span, when the client attached one
+    (utils/trace.py — the xid-correlated span is stamped with the
+    reply zxid here, where the reply routes back by xid).  Safe on
+    every settle path: a span closes once, first outcome wins."""
+    span = getattr(req, 'span', None)
+    if span is not None:
+        span.finish(zxid=zxid, status=status, error=error)
+
+
 class ZKRequest(EventEmitter):
     """One in-flight request: emits 'reply' (packet) or 'error' (exc)
-    exactly once (reference: lib/connection-fsm.js:378-382)."""
+    exactly once (reference: lib/connection-fsm.js:378-382).  The
+    client facade may attach a trace ``span``; the connection's
+    reply/error routing closes it."""
 
     def __init__(self, packet: dict):
         super().__init__()
         self.packet = packet
+        #: Optional utils/trace.Span, attached by Client._start_op.
+        self.span = None
 
     def as_future(self) -> asyncio.Future:
         """Adapt to an awaitable resolving to the reply packet.
@@ -123,6 +140,18 @@ class ZKConnection(FSM):
         #: (reference: zcf_reqs).
         self.reqs: dict[int, ZKRequest] = {}
         self._dial_task: asyncio.Task | None = None
+        #: Dial/handshake latency instrumentation: t0 set on entering
+        #: 'connecting' (or on promote for a parked spare), observed
+        #: into the histogram on reaching 'connected'.
+        self._connect_t0: float | None = None
+        collector = getattr(client, 'collector', None)
+        self._connect_latency = None
+        if collector is not None:
+            self._connect_latency = collector.histogram(
+                METRIC_ZK_CONNECT_LATENCY,
+                'TCP connect + ZK handshake latency, milliseconds, '
+                'by backend')
+            self.bind_fsm_metrics(collector, 'ZKConnection')
         super().__init__('init')
 
     # -- public controls (reference: lib/connection-fsm.js:51-76) --
@@ -146,6 +175,9 @@ class ZKConnection(FSM):
         handshake on the already-open socket."""
         assert self.is_in_state('parked'), self.get_state()
         self.spare = False
+        # a promoted spare's latency sample measures the handshake
+        # only — the TCP dial was paid when it parked
+        self._connect_t0 = time.monotonic()
         self.emit('promoteAsserted')
 
     def next_xid(self) -> int:
@@ -161,6 +193,7 @@ class ZKConnection(FSM):
         self.codec = PacketCodec(
             use_native=getattr(self.client, 'use_native_codec', None))
         self.log.debug('attempting new connection')
+        self._connect_t0 = time.monotonic()
 
         async def dial():
             loop = asyncio.get_running_loop()
@@ -287,6 +320,13 @@ class ZKConnection(FSM):
         self.codec.handshaking = False
         self.log = self.log.child(
             sessionId=self.session.get_session_id())
+
+        if self._connect_latency is not None and \
+                self._connect_t0 is not None:
+            self._connect_latency.observe(
+                (time.monotonic() - self._connect_t0) * 1000.0,
+                {'backend': self.backend.key})
+            self._connect_t0 = None
 
         ping_interval = max(self.session.get_timeout() / 4, 2000)
         S.interval(ping_interval, self.ping)
@@ -429,6 +469,9 @@ class ZKConnection(FSM):
             wrapped.__cause__ = req_err
             req_err = wrapped
         for req in reqs.values():
+            _finish_span(req, status='error',
+                         error=getattr(req_err, 'code', None)
+                         or type(req_err).__name__)
             req.emit('error', req_err)
 
         # Deliberately not scope-bound: the 'error' event must fire even
@@ -462,6 +505,7 @@ class ZKConnection(FSM):
             err = ZKProtocolError('CONNECTION_LOSS', 'Connection closed.')
             reqs, self.reqs = self.reqs, {}
             for req in reqs.values():
+                _finish_span(req, status='error', error=err.code)
                 req.emit('error', err)
         S.immediate(fail_stragglers)
 
@@ -502,8 +546,11 @@ class ZKConnection(FSM):
         if req is None:
             return
         if pkt['err'] == 'OK':
+            _finish_span(req, zxid=pkt.get('zxid'))
             req.emit('reply', pkt)
         else:
+            _finish_span(req, zxid=pkt.get('zxid'), status='error',
+                         error=pkt['err'])
             req.emit('error', ZKError(pkt['err']), pkt)
 
     def request(self, pkt: dict) -> ZKRequest:

@@ -406,15 +406,23 @@ class ScheduleResult:
     faults: int = 0
     watch_fires: int = 0
     violations: list = dataclasses.field(default_factory=list)
+    #: The client's xid-correlated span ring (utils/trace.py), dumped
+    #: after the schedule: on a violation this is the exact
+    #: request/reply/notification interleaving that produced it.
+    trace: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.violations
 
 
-async def run_schedule(seed: int, ops: int = 6) -> ScheduleResult:
+async def run_schedule(seed: int, ops: int = 6,
+                       collector=None) -> ScheduleResult:
     """Run one seeded fault schedule against a fresh in-process server
-    and client; returns the invariant-check result.
+    and client; returns the invariant-check result.  ``collector``
+    (utils/metrics.Collector) is threaded to the client when given, so
+    a caller can scrape latency histograms / FSM metrics after the
+    schedule; the client's span ring is always dumped into the result.
 
     Invariants asserted (violations listed in the result, seed
     attached, so any failure is reproducible with the same seed):
@@ -442,6 +450,7 @@ async def run_schedule(seed: int, ops: int = 6) -> ScheduleResult:
     client = Client(
         address='127.0.0.1', port=srv.port, session_timeout=3000,
         seed=seed, faults=inj, op_timeout=CAMPAIGN_OP_DEADLINE_MS,
+        collector=collector,
         connect_policy=BackoffPolicy(timeout=400, retries=2,
                                      delay=30, cap=200),
         default_policy=BackoffPolicy(timeout=400, retries=3,
@@ -585,6 +594,8 @@ async def run_schedule(seed: int, ops: int = 6) -> ScheduleResult:
             res.violations.append('client.close() hung past 5s')
         await srv.stop()
         inj.close()
+        # dump after teardown so close-phase errors are captured too
+        res.trace = client.trace.dump()
 
 
 async def run_campaign(base_seed: int, schedules: int,

@@ -61,6 +61,7 @@ from __future__ import annotations
 import asyncio
 import queue
 import threading
+import time
 from typing import TYPE_CHECKING
 
 import numpy as np
@@ -73,6 +74,7 @@ from ..protocol.records import (
     _RESP_READERS,
 )
 from ..utils.logging import Logger
+from ..utils.metrics import Histogram
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from .connection import ZKConnection  # noqa: quoted annotations
@@ -197,6 +199,13 @@ class FleetIngest:
         self.ticks = 0
         self.ticks_scalar = 0
         self.ticks_warming = 0
+        #: Batched-drain latency distribution: wall time of each tick
+        #: that routed work (device dispatch or scalar drain), ms.
+        #: Standalone until bind_metrics() swaps in a collector-
+        #: registered histogram at setup time.
+        self.tick_hist = Histogram(
+            'zkstream_ingest_tick_ms',
+            'Ingest tick (batched drain) duration, milliseconds')
         #: ticks routed to the scalar drain by the fragmentation guard
         self.ticks_frag = 0
         self.frames_routed = 0
@@ -588,6 +597,12 @@ class FleetIngest:
             collector.gauge(prefix + name,
                             (lambda a=attr: getattr(self, a)),
                             help_text)
+        # swap the standalone tick-duration histogram for a collector-
+        # registered one; samples observed before binding stay with the
+        # discarded instance (bind at setup time)
+        self.tick_hist = collector.histogram(
+            prefix + 'zkstream_ingest_tick_ms',
+            'Ingest tick (batched drain) duration, milliseconds')
 
     async def prewarm(self, n_streams: int,
                       nbytes: int | None = None) -> None:
@@ -787,6 +802,14 @@ class FleetIngest:
                     buf[:0] = resid
 
     def _tick(self) -> None:
+        t0 = time.perf_counter()
+        if self._tick_impl():
+            self.tick_hist.observe((time.perf_counter() - t0) * 1000.0)
+
+    def _tick_impl(self) -> bool:
+        """One drain tick; returns True when it routed work (those
+        ticks feed the duration histogram — empty bookkeeping wakeups
+        would only blur the distribution's low end)."""
         self._scheduled = False
         win = self._window_bytes
         self._window_bytes = 0
@@ -795,7 +818,7 @@ class FleetIngest:
                                else 0.2 * win + 0.8 * self._ema_bytes)
         if self._direct:
             if not win:
-                return
+                return False
             # deliveries already happened inline (connection-side
             # drain or feed()); this tick is bookkeeping + the regime
             # decision.  Policy FIRST, then count: ticks_frag must
@@ -808,17 +831,18 @@ class FleetIngest:
                 self.ticks_frag += 1
             if not still_direct:
                 self._flip_batch()
-            return
+            return True
         active = [(conn, buf) for conn, buf in self._slots.values()
                   if buf and conn.is_in_state('connected')]
         if not active:
-            return
+            return False
         before = self.frames_routed
         try:
             self._tick_inner(active)
         finally:
             self._note_frames(self.frames_routed - before)
             self._frames_mark = self.frames_routed
+        return True
 
     def _tick_inner(self, active) -> None:
         if self._want_direct():

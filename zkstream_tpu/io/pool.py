@@ -27,6 +27,7 @@ import asyncio
 import random
 
 from ..utils.events import EventEmitter
+from ..utils.fsm import note_transition
 from ..utils.logging import Logger
 from .backoff import BackoffPolicy
 from .connection import Backend, ZKConnection
@@ -141,8 +142,15 @@ class ConnectionPool(EventEmitter):
         self._drop_conn(destroy=True)
         self._set_state('stopped')
 
+    def get_state(self) -> str:
+        """The pool's state name — the not-quite-FSM's analogue of
+        FSM.get_state(), so the fsm metric bindings (utils/fsm.py)
+        census it alongside the real machines."""
+        return self.state
+
     def _set_state(self, st: str) -> None:
         if self.state != st:
+            note_transition(self, self.state, st)
             self.state = st
             self.emit('stateChanged', st)
 

@@ -43,7 +43,8 @@ class ZKSession(FSM):
     def __init__(self, timeout: int, collector: Collector | None = None,
                  log: Logger | None = None,
                  retry_policy: BackoffPolicy | None = None,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 trace=None):
         # Child logger; sessionId accretes once the server assigns one
         # (reference: lib/zk-session.js:42-44,179-181).
         self.log = Logger(log).child(component='ZKSession')
@@ -62,6 +63,10 @@ class ZKSession(FSM):
         self.collector = collector if collector is not None else Collector()
         self.collector.counter(METRIC_ZK_NOTIFICATION_COUNTER,
             'Notifications received from ZooKeeper')
+        #: Optional TraceRing (utils/trace.py) shared with the owning
+        #: client: notification deliveries are recorded into it so a
+        #: span dump interleaves requests and watch events.
+        self.trace = trace
 
         #: The session triple that makes resumption possible
         #: (reference: lib/zk-session.js:57-59).
@@ -82,6 +87,7 @@ class ZKSession(FSM):
                                                   cap=2000)).backoff(seed)
         self._rearm_handle: asyncio.TimerHandle | None = None
 
+        self.bind_fsm_metrics(self.collector, 'ZKSession')
         super().__init__('detached')
 
     # -- public accessors --
@@ -382,6 +388,10 @@ class ZKSession(FSM):
         self.log.trace('notification %s for %s', evt, pkt['path'])
         self.collector.get_collector(
             METRIC_ZK_NOTIFICATION_COUNTER).increment({'event': evt})
+        if self.trace is not None:
+            self.trace.note('NOTIFICATION', pkt['path'],
+                            zxid=self.last_zxid, kind='notification',
+                            session_id=self.get_session_id())
         watcher = self.watchers.get(pkt['path'])
         if watcher is not None:
             watcher.notify(evt)

@@ -28,11 +28,14 @@ the duplicate user-facing emits this can cause.
 from __future__ import annotations
 
 import random
+import time
 
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
 from .backoff import BackoffPolicy
+
+METRIC_ZK_WATCH_REARM_LATENCY = 'zookeeper_watch_rearm_latency_ms'
 
 #: Re-arm pacing after consecutive arm failures: base 5 ms doubling to
 #: a 500 ms cap — well below any session timeout, so a watch is never
@@ -144,6 +147,17 @@ class ZKWatchEvent(FSM):
         #: ``_arm_retry`` is the "last attempt failed" latch.
         self._arm_backoff = ARM_RETRY_POLICY.backoff()
         self._arm_retry = False
+        #: (Re-)arm latency instrumentation: the arming read's
+        #: round-trip, labelled by watch kind — the window a watch is
+        #: dark after a notification consumed it server-side.
+        collector = getattr(session, 'collector', None)
+        self._rearm_latency = None
+        if collector is not None:
+            self._rearm_latency = collector.histogram(
+                METRIC_ZK_WATCH_REARM_LATENCY,
+                'Watch (re-)arm read round-trip latency, '
+                'milliseconds, by watch event kind')
+            self.bind_fsm_metrics(collector, 'ZKWatchEvent')
         #: True after 'deleted' was emitted for the node's current
         #: absence: re-arming an existence watch on a still-missing
         #: node (connection churn forces re-arms) must not re-emit
@@ -154,6 +168,11 @@ class ZKWatchEvent(FSM):
     def _arm_ok(self) -> None:
         self._arm_retry = False
         self._arm_backoff.reset()
+
+    def _observe_rearm(self, t0: float) -> None:
+        if self._rearm_latency is not None:
+            self._rearm_latency.observe(
+                (time.monotonic() - t0) * 1000.0, {'event': self.evt})
 
     def get_event(self) -> str:
         return self.evt
@@ -232,6 +251,7 @@ class ZKWatchEvent(FSM):
             self._arm_retry = True
             S.immediate(lambda: S.goto_state('wait_session'))
             return
+        arm_t0 = time.monotonic()
         req = conn.request(self.to_packet())
 
         def on_reply(pkt):
@@ -251,6 +271,7 @@ class ZKWatchEvent(FSM):
             # this suppresses duplicate notifications from the server
             # watch-kind overlap (reference: lib/zk-session.js:849-856).
             self._arm_ok()
+            self._observe_rearm(arm_t0)
             self._deleted_seen = False
             if self.prev_zxid is not None and zxid == self.prev_zxid:
                 S.goto_state('armed')
@@ -272,6 +293,7 @@ class ZKWatchEvent(FSM):
                 # 'deleted' once per disappearance: churn-forced
                 # re-arms over the same absence stay silent.
                 self._arm_ok()
+                self._observe_rearm(arm_t0)
                 if not self._deleted_seen:
                     self._deleted_seen = True
                     EventEmitter.emit(self.emitter, 'deleted')
@@ -281,6 +303,7 @@ class ZKWatchEvent(FSM):
                 # Other watch kinds cannot attach to a missing node;
                 # park until it is created.
                 self._arm_ok()
+                self._observe_rearm(arm_t0)
                 S.goto_state('wait_node')
                 return
             self._arm_retry = True

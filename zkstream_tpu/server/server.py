@@ -30,6 +30,10 @@ from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 
 log = logging.getLogger('zkstream_tpu.server')
 
+#: ZooKeeper four-letter admin words this server answers (raw bytes,
+#: no length prefix, sent as a connection's very first payload).
+ADMIN_WORDS = frozenset((b'ruok', b'mntr', b'stat', b'srvr'))
+
 
 class ServerConnection:
     """One accepted client socket: handshake, request dispatch, and this
@@ -50,6 +54,13 @@ class ServerConnection:
         self.child_watches: dict[str, bool] = {}
         self.closed = False
         self._subscribed = False
+        #: First-bytes buffer for four-letter admin word detection: a
+        #: real ZK handshake starts with a 4-byte big-endian length
+        #: (0x00 0x00 0x00 0x2c-ish), which can never collide with an
+        #: ASCII admin word, so the first four bytes decide the
+        #: connection's fate exactly once.
+        self._admin_buf = b''
+        self._admin_checked = False
 
     # -- wire helpers --
 
@@ -67,6 +78,7 @@ class ServerConnection:
     def _send(self, pkt: dict) -> None:
         if self.closed:
             return
+        self.server.packets_sent += 1
         self._write_bytes(self.codec.encode(pkt))
 
     def _reply(self, xid: int, opcode: str, err: str = 'OK',
@@ -90,6 +102,7 @@ class ServerConnection:
         mutation."""
         if self.closed:
             return
+        self.server.packets_sent += 1
         key = (ntype, path, zxid)
         cache = self.server._notif_cache
         if cache is not None and cache[0] == key:
@@ -162,22 +175,64 @@ class ServerConnection:
                 data = await self.reader.read(65536)
                 if not data:
                     break
+                if not self._admin_checked:
+                    # ZooKeeper four-letter words arrive raw (no
+                    # length prefix) as the connection's first bytes.
+                    self._admin_buf += data
+                    if len(self._admin_buf) < 4:
+                        continue
+                    self._admin_checked = True
+                    word = self._admin_buf[:4]
+                    if word in ADMIN_WORDS:
+                        await self._handle_admin(word.decode('ascii'))
+                        break
+                    # not an admin word: replay everything buffered
+                    # through the normal codec path
+                    data, self._admin_buf = self._admin_buf, b''
                 try:
                     pkts = self.codec.decode(data)
                 except ZKProtocolError as e:
                     log.debug('server: undecodable input: %s', e)
                     break
-                for pkt in pkts:
-                    if self.codec.handshaking:
-                        self._handle_connect(pkt)
-                    else:
-                        self._handle_request(pkt)
-                    if self.closed:
-                        break
+                # Outstanding accounting is batch-scoped: a pipelined
+                # read delivers N requests at once, and every one is
+                # outstanding until its handler replies.  (Handlers
+                # are synchronous today, so a concurrent mntr scrape
+                # observes nonzero only across a handler that awaits —
+                # e.g. via an injected fault gate — but the accounting
+                # stays correct if handlers ever grow await points.)
+                self.server.outstanding += len(pkts)
+                remaining = len(pkts)
+                try:
+                    for pkt in pkts:
+                        self.server.packets_received += 1
+                        if self.codec.handshaking:
+                            self._handle_connect(pkt)
+                        else:
+                            self._handle_request(pkt)
+                        self.server.outstanding -= 1
+                        remaining -= 1
+                        if self.closed:
+                            break
+                finally:
+                    # a close/raise mid-batch must still retire the
+                    # unhandled remainder from the gauge
+                    self.server.outstanding -= remaining
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             self.close()
+
+    async def _handle_admin(self, word: str) -> None:
+        """Serve one four-letter admin word: raw text reply, then
+        close — real ZK's mntr/ruok/stat/srvr contract."""
+        text = self.server.admin_text(word)
+        try:
+            self.writer.write(text.encode('utf-8'))
+            await self.writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+        self.close()
 
     def close(self) -> None:
         if self.closed:
@@ -387,6 +442,14 @@ class ZKServer:
         self._notif_cache: tuple[tuple, bytes] | None = None
         self._notif_codec = PacketCodec(server=True)
         self._notif_codec.handshaking = False
+        #: Introspection counters for the four-letter admin words
+        #: (mntr/stat/srvr): requests decoded, replies/notifications
+        #: sent, and requests decoded but not yet replied (batch-
+        #: scoped: a pipelined read's whole batch counts until each
+        #: member's handler returns).
+        self.packets_received = 0
+        self.packets_sent = 0
+        self.outstanding = 0
 
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(
@@ -426,6 +489,69 @@ class ZKServer:
     @property
     def address(self) -> tuple[str, int]:
         return (self.host, self.port)
+
+    # -- four-letter admin words (ruok / mntr / stat / srvr) --
+
+    def watch_count(self) -> int:
+        """Armed one-shot watches across this member's connections."""
+        return sum(len(c.data_watches) + len(c.child_watches)
+                   for c in self.conns)
+
+    def mode(self) -> str:
+        return 'standalone' if self.store is self.db else 'follower'
+
+    def monitor_stats(self) -> list[tuple[str, object]]:
+        """The ``mntr`` key/value inventory (ordered), real-ZK key
+        names where an equivalent exists."""
+        ephemerals = sum(len(s.ephemerals)
+                         for s in self.db.sessions.values())
+        data_size = sum(len(n.data)
+                        for n in self.store.nodes.values())
+        return [
+            ('zk_version', 'zkstream_tpu'),
+            ('zk_server_state', self.mode()),
+            ('zk_znode_count', len(self.store.nodes)),
+            ('zk_watch_count', self.watch_count()),
+            ('zk_outstanding_requests', self.outstanding),
+            ('zk_num_alive_connections', len(self.conns)),
+            ('zk_packets_received', self.packets_received),
+            ('zk_packets_sent', self.packets_sent),
+            ('zk_ephemerals_count', ephemerals),
+            ('zk_approximate_data_size', data_size),
+            ('zk_sessions', len(self.db.sessions)),
+            ('zk_zxid', '0x%x' % (self.store.zxid,)),
+        ]
+
+    def admin_text(self, word: str) -> str:
+        """Render one four-letter word's reply text."""
+        if word == 'ruok':
+            return 'imok'
+        if word == 'mntr':
+            return ''.join('%s\t%s\n' % kv
+                           for kv in self.monitor_stats())
+        if word in ('stat', 'srvr'):
+            lines = ['Zookeeper version: zkstream_tpu (in-process)']
+            if word == 'stat':
+                lines.append('Clients:')
+                for c in self.conns:
+                    sid = c.session.id if c.session is not None else 0
+                    peer = c.writer.get_extra_info('peername')
+                    addr = ('%s:%d' % (peer[0], peer[1])
+                            if peer else 'unknown')
+                    lines.append(' /%s[1](sid=0x%x)' % (addr, sid))
+                lines.append('')
+            lines += [
+                'Latency min/avg/max: 0/0/0',
+                'Received: %d' % (self.packets_received,),
+                'Sent: %d' % (self.packets_sent,),
+                'Connections: %d' % (len(self.conns),),
+                'Outstanding: %d' % (self.outstanding,),
+                'Zxid: 0x%x' % (self.store.zxid,),
+                'Mode: %s' % (self.mode(),),
+                'Node count: %d' % (len(self.store.nodes),),
+            ]
+            return '\n'.join(lines) + '\n'
+        raise ValueError('unknown admin word %r' % (word,))
 
 
 class ZKEnsemble:

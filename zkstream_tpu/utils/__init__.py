@@ -4,4 +4,5 @@ events / artedi dependencies)."""
 
 from .events import EventEmitter  # noqa: F401
 from .fsm import FSM, StateScope  # noqa: F401
-from .metrics import Collector, Counter  # noqa: F401
+from .metrics import Collector, Counter, Gauge, Histogram  # noqa: F401
+from .trace import Span, TraceRing  # noqa: F401

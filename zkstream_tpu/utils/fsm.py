@@ -21,10 +21,67 @@ races tractable, so this module provides the same contract for asyncio:
 from __future__ import annotations
 
 import asyncio
+import weakref
 from typing import Callable
 
 from .events import EventEmitter
 from .aio import ambient_loop
+
+METRIC_FSM_TRANSITIONS = 'zkstream_fsm_transitions'
+METRIC_FSM_STATE = 'zkstream_fsm_state'
+
+
+def _fsm_state_counts(registry) -> dict:
+    """Current-state census over a weak registry of instrumented
+    machines: {labels: count of live machines in that state}."""
+    counts: dict[tuple[str, str], int] = {}
+    for machine in list(registry):
+        label = getattr(machine, '_fsm_metrics_label', None)
+        state = machine.get_state()
+        if label is None or not state:
+            continue
+        counts[(label, state)] = counts.get((label, state), 0) + 1
+    return {(('fsm', label), ('state', state)): float(n)
+            for (label, state), n in counts.items()}
+
+
+def bind_transition_metrics(machine, collector,
+                            label: str | None = None) -> None:
+    """Instrument any object with a ``get_state()`` and state
+    transitions (FSM subclasses get the counting for free via
+    ``FSM._transition``; the pool calls :func:`note_transition`
+    manually) so ``collector`` exposes:
+
+    - ``zkstream_fsm_transitions{fsm,from,to}`` — a counter bumped on
+      every transition;
+    - ``zkstream_fsm_state{fsm,state}`` — a pull gauge counting live
+      machines per (label, state) at scrape time.
+
+    The registry holds weak references, so instrumented machines are
+    censused only while alive; binding is idempotent per collector
+    (the counter is fetched, the gauge registered once)."""
+    if label is None:
+        label = type(machine).__name__
+    machine._fsm_metrics_ctr = collector.counter(
+        METRIC_FSM_TRANSITIONS, 'FSM state transitions')
+    machine._fsm_metrics_label = label
+    registry = getattr(collector, '_fsm_registry', None)
+    if registry is None:
+        registry = collector._fsm_registry = weakref.WeakSet()
+        collector.multi_gauge(
+            METRIC_FSM_STATE,
+            lambda reg=registry: _fsm_state_counts(reg),
+            'Live state machines per (fsm, state)')
+    registry.add(machine)
+
+
+def note_transition(machine, old: str | None, new: str) -> None:
+    """Count one state transition on the machine's bound collector
+    (no-op until :func:`bind_transition_metrics` ran)."""
+    ctr = getattr(machine, '_fsm_metrics_ctr', None)
+    if ctr is not None:
+        ctr.increment({'fsm': machine._fsm_metrics_label,
+                       'from': old or '', 'to': new})
 
 
 class StateScope:
@@ -110,6 +167,14 @@ class FSM(EventEmitter):
             return False
         return self._state == name or self._state.startswith(name + '.')
 
+    def bind_fsm_metrics(self, collector, label: str | None = None) \
+            -> None:
+        """Expose this machine's transitions/current state on
+        ``collector`` (see :func:`bind_transition_metrics`).  Called
+        before ``super().__init__`` the initial transition is counted
+        too; after, counting starts from the next transition."""
+        bind_transition_metrics(self, collector, label)
+
     def _transition(self, name: str) -> None:
         # A transition triggered from inside a state_* entry function is
         # deferred until the entry function returns (mooremachine allows
@@ -139,6 +204,7 @@ class FSM(EventEmitter):
                                  (type(self).__name__, name))
         scope = StateScope(self, name)
         self._scopes.append((name, scope))
+        note_transition(self, self._state, name)
         self._state = name
         self._in_transition = True
         try:

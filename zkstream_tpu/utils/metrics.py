@@ -1,13 +1,45 @@
-"""Prometheus-style metrics counters.
+"""Prometheus-style metrics: counters, gauges, and histograms.
 
 The reference records client events through the artedi collector
 (reference: lib/client.js:46-61, lib/zk-session.js:61-65).  This is a
-dependency-free equivalent: labelled counters plus text exposition in
-the Prometheus format.  A caller may supply their own ``Collector`` to
-``Client`` or let one be created internally, as in the reference.
+dependency-free equivalent: labelled counters, pull-model gauges, and
+cumulative-bucket histograms with text exposition in the Prometheus
+format.  A caller may supply their own ``Collector`` to ``Client`` or
+let one be created internally, as in the reference.
+
+Label values are escaped per the Prometheus exposition spec
+(backslash, double quote, and newline), so a path or error string can
+ride in a label without producing unparseable scrape output.
 """
 
 from __future__ import annotations
+
+
+def escape_label_value(value) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    ``\\`` -> ``\\\\``, ``"`` -> ``\\"``, newline -> ``\\n``."""
+    return (str(value)
+            .replace('\\', '\\\\')
+            .replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _render_labels(key: tuple[tuple[str, str], ...],
+                   extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = tuple(key) + tuple(extra)
+    if not pairs:
+        return ''
+    return '{%s}' % ','.join(
+        '%s="%s"' % (k, escape_label_value(v)) for k, v in pairs)
+
+
+def _label_key(labels) -> tuple[tuple[str, str], ...]:
+    """Normalize a label set (dict, or an iterable of (k, v) pairs —
+    MultiGauge callbacks need hashable keys) to a sorted tuple."""
+    if not labels:
+        return ()
+    items = labels.items() if isinstance(labels, dict) else labels
+    return tuple(sorted(items))
 
 
 class Counter:
@@ -18,11 +50,11 @@ class Counter:
 
     def increment(self, labels: dict[str, str] | None = None,
                   by: float = 1.0) -> None:
-        key = tuple(sorted((labels or {}).items()))
+        key = _label_key(labels)
         self._values[key] = self._values.get(key, 0.0) + by
 
     def value(self, labels: dict[str, str] | None = None) -> float:
-        return self._values.get(tuple(sorted((labels or {}).items())), 0.0)
+        return self._values.get(_label_key(labels), 0.0)
 
     def expose(self) -> str:
         lines = []
@@ -30,12 +62,8 @@ class Counter:
             lines.append('# HELP %s %s' % (self.name, self.help))
         lines.append('# TYPE %s counter' % (self.name,))
         for key, val in sorted(self._values.items()):
-            if key:
-                labelstr = '{%s}' % ','.join(
-                    '%s="%s"' % (k, v) for k, v in key)
-            else:
-                labelstr = ''
-            lines.append('%s%s %s' % (self.name, labelstr, val))
+            lines.append('%s%s %s' % (self.name, _render_labels(key),
+                                      val))
         return '\n'.join(lines)
 
 
@@ -62,39 +90,212 @@ class Gauge:
         return '\n'.join(lines)
 
 
+class MultiGauge:
+    """A pull-model gauge with one series per label set: the callback
+    returns ``{labels_dict: value}`` at exposition time.  Used for the
+    FSM current-state gauge, where the series population (which
+    machines exist, which states they sit in) changes at runtime."""
+
+    def __init__(self, name: str, fn, help_text: str = ''):
+        self.name = name
+        self.help = help_text
+        self._fn = fn
+
+    def expose(self) -> str:
+        lines = []
+        if self.help:
+            lines.append('# HELP %s %s' % (self.name, self.help))
+        lines.append('# TYPE %s gauge' % (self.name,))
+        try:
+            values = {_label_key(labels): val
+                      for labels, val in self._fn().items()}
+        except Exception:  # a dead callback must not sink exposition
+            lines.append('%s %s' % (self.name, float('nan')))
+            return '\n'.join(lines)
+        for key, val in sorted(values.items()):
+            lines.append('%s%s %s' % (self.name, _render_labels(key),
+                                      val))
+        return '\n'.join(lines)
+
+
+#: Default latency buckets, milliseconds: sub-ms client-loop hops up
+#: through multi-second retry storms.
+DEFAULT_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                   250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """A labelled Prometheus histogram: cumulative ``_bucket`` series
+    (``le`` upper bounds plus ``+Inf``), ``_sum``, and ``_count``.
+
+    ``observe`` is the hot-path call: one bisect-free linear scan over
+    a small tuple of bounds plus two adds — cheap enough for per-op
+    recording."""
+
+    def __init__(self, name: str, help_text: str = '',
+                 buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        bounds = tuple(sorted(float(b) for b in buckets))
+        assert bounds, 'histogram needs at least one bucket bound'
+        self.buckets = bounds
+        #: label key -> [per-bucket counts..., +Inf count, sum]
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def _row(self, labels: dict[str, str] | None) -> list:
+        key = _label_key(labels)
+        row = self._series.get(key)
+        if row is None:
+            row = self._series[key] = [0] * (len(self.buckets) + 1) \
+                + [0.0]
+        return row
+
+    def observe(self, value: float,
+                labels: dict[str, str] | None = None) -> None:
+        row = self._row(labels)
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                row[i] += 1
+                break
+        else:
+            row[len(self.buckets)] += 1     # +Inf-only
+        row[-1] += value
+
+    def count(self, labels: dict[str, str] | None = None) -> int:
+        row = self._series.get(_label_key(labels))
+        return sum(row[:-1]) if row is not None else 0
+
+    def sum(self, labels: dict[str, str] | None = None) -> float:
+        row = self._series.get(_label_key(labels))
+        return row[-1] if row is not None else 0.0
+
+    def bucket_value(self, le: float,
+                     labels: dict[str, str] | None = None) -> int:
+        """Cumulative count for the bucket with upper bound ``le``
+        (``float('inf')`` for the +Inf bucket)."""
+        row = self._series.get(_label_key(labels))
+        if row is None:
+            return 0
+        if le == float('inf'):
+            return sum(row[:-1])
+        idx = self.buckets.index(float(le))
+        return sum(row[:idx + 1])
+
+    @staticmethod
+    def _fmt_bound(bound: float) -> str:
+        return '%g' % (bound,)
+
+    def expose(self) -> str:
+        lines = []
+        if self.help:
+            lines.append('# HELP %s %s' % (self.name, self.help))
+        lines.append('# TYPE %s histogram' % (self.name,))
+        for key, row in sorted(self._series.items()):
+            cum = 0
+            for i, bound in enumerate(self.buckets):
+                cum += row[i]
+                lines.append('%s_bucket%s %d' % (
+                    self.name,
+                    _render_labels(key, (('le', self._fmt_bound(bound)),)),
+                    cum))
+            cum += row[len(self.buckets)]
+            lines.append('%s_bucket%s %d' % (
+                self.name, _render_labels(key, (('le', '+Inf'),)), cum))
+            lines.append('%s_sum%s %s' % (self.name,
+                                          _render_labels(key), row[-1]))
+            lines.append('%s_count%s %d' % (self.name,
+                                            _render_labels(key), cum))
+        return '\n'.join(lines)
+
+
 class Collector:
     def __init__(self) -> None:
         self._counters: dict[str, Counter] = {}
-        self._gauges: dict[str, Gauge] = {}
+        self._gauges: dict[str, Gauge | MultiGauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def _check_collision(self, name: str, kind: str) -> None:
+        for other_kind, table in (('counter', self._counters),
+                                  ('gauge', self._gauges),
+                                  ('histogram', self._histograms)):
+            if kind != other_kind and name in table:
+                raise ValueError(
+                    'metric %r already registered as a %s'
+                    % (name, other_kind))
 
     def counter(self, name: str, help_text: str = '') -> Counter:
         """Create (or fetch) a counter by name — idempotent, like
         artedi's collector.counter()."""
-        if name in self._gauges:
-            raise ValueError(
-                'metric %r already registered as a gauge' % (name,))
+        self._check_collision(name, 'counter')
         if name not in self._counters:
             self._counters[name] = Counter(name, help_text)
         return self._counters[name]
 
-    def gauge(self, name: str, fn, help_text: str = '') -> Gauge:
-        """Register a callback-backed gauge.  A name collision raises:
-        silently replacing would drop the first registrant's series
-        (bind two instrumented components under distinct prefixes
-        instead)."""
-        if name in self._gauges or name in self._counters:
+    def histogram(self, name: str, help_text: str = '',
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        """Create (or fetch) a histogram by name — idempotent like
+        :meth:`counter`, so shared collectors (many clients, one
+        scrape) register per-op latency once.  Re-registering with
+        DIFFERENT bucket bounds raises: silently handing back the
+        first registrant's buckets would mis-bucket the second
+        registrant's observations with no warning."""
+        self._check_collision(name, 'histogram')
+        existing = self._histograms.get(name)
+        if existing is not None:
+            want = tuple(sorted(float(b) for b in buckets))
+            if want != existing.buckets:
+                raise ValueError(
+                    'histogram %r already registered with buckets %r '
+                    '(requested %r); use a distinct name/prefix'
+                    % (name, existing.buckets, want))
+            return existing
+        self._histograms[name] = Histogram(name, help_text, buckets)
+        return self._histograms[name]
+
+    def _check_gauge_free(self, name: str) -> None:
+        """Gauges are never idempotent — a same-name registration (of
+        any kind) raises: silently replacing would drop the first
+        registrant's series (bind two instrumented components under
+        distinct prefixes instead)."""
+        self._check_collision(name, 'gauge')
+        if name in self._gauges:
             raise ValueError(
                 'metric %r already registered; use a distinct '
                 'name/prefix' % (name,))
+
+    def gauge(self, name: str, fn, help_text: str = '') -> Gauge:
+        """Register a callback-backed gauge (see
+        :meth:`_check_gauge_free` for the collision policy)."""
+        self._check_gauge_free(name)
         self._gauges[name] = Gauge(name, fn, help_text)
         return self._gauges[name]
+
+    def multi_gauge(self, name: str, fn,
+                    help_text: str = '') -> MultiGauge:
+        """Register a labelled pull gauge (callback returns
+        ``{labels: value}``); same collision policy as :meth:`gauge`."""
+        self._check_gauge_free(name)
+        self._gauges[name] = MultiGauge(name, fn, help_text)
+        return self._gauges[name]
+
+    def histograms(self) -> list[Histogram]:
+        return list(self._histograms.values())
 
     def get_collector(self, name: str):
         if name in self._counters:
             return self._counters[name]
-        return self._gauges[name]
+        if name in self._histograms:
+            return self._histograms[name]
+        if name in self._gauges:
+            return self._gauges[name]
+        registered = sorted(list(self._counters) + list(self._gauges)
+                            + list(self._histograms))
+        raise ValueError(
+            'no metric %r registered; registered names: %s'
+            % (name, ', '.join(registered) or '(none)'))
 
     def expose(self) -> str:
         parts = [c.expose() for c in self._counters.values()]
+        parts += [h.expose() for h in self._histograms.values()]
         parts += [g.expose() for g in self._gauges.values()]
         return '\n'.join(parts)

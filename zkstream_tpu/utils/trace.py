@@ -1,0 +1,141 @@
+"""Lightweight xid-correlated op tracing.
+
+The metrics layer answers "how much / how slow in aggregate"; this
+module answers "what happened to THAT request".  A :class:`Span` is
+created per client op (client.py), threaded by xid through the
+connection's pending-request table (io/connection.py) and stamped with
+the reply's zxid when the reply routes back; the session layer records
+notification deliveries into the same ring (io/session.py), so one
+dump interleaves requests, replies, errors, and watch notifications in
+arrival order.
+
+Spans live in a bounded in-memory ring buffer (:class:`TraceRing`) —
+fixed memory, no I/O, safe to leave on in production.  The chaos
+campaign (io/faults.py, tests/test_chaos.py, ``chaos`` CLI) dumps the
+ring alongside the failing seed, so a schedule failure arrives with
+the exact request/reply interleaving that produced it instead of a
+log-grepping session.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import time
+
+
+class Span:
+    """One traced operation: request-side fields stamped at creation,
+    reply-side fields stamped on completion."""
+
+    __slots__ = ('span_id', 'kind', 'op', 'path', 'xid', 'zxid',
+                 'backend', 'session_id', 'status', 'error',
+                 't_wall', '_t0', 'duration_ms')
+
+    def __init__(self, span_id: int, op: str, path: str | None = None,
+                 kind: str = 'op'):
+        self.span_id = span_id
+        self.kind = kind          # 'op' | 'notification' | 'event'
+        self.op = op
+        self.path = path
+        self.xid: int | None = None
+        self.zxid: int | None = None
+        self.backend: str | None = None
+        self.session_id: str | None = None
+        self.status: str = 'open'
+        self.error: str | None = None
+        self.t_wall = time.time()
+        self._t0 = time.monotonic()
+        self.duration_ms: float | None = None
+
+    def finish(self, zxid: int | None = None, status: str = 'ok',
+               error: str | None = None) -> None:
+        """Close the span exactly once; a double-settle (teardown races
+        in the connection) keeps the first outcome."""
+        if self.status != 'open':
+            return
+        self.duration_ms = (time.monotonic() - self._t0) * 1000.0
+        if zxid is not None:
+            self.zxid = zxid
+        self.status = status
+        self.error = error
+
+    def to_dict(self) -> dict:
+        d = {'span': self.span_id, 'kind': self.kind, 'op': self.op,
+             'status': self.status, 't_wall': round(self.t_wall, 6)}
+        for field in ('path', 'xid', 'zxid', 'backend', 'session_id',
+                      'error'):
+            val = getattr(self, field)
+            if val is not None:
+                d[field] = val
+        if self.duration_ms is not None:
+            d['duration_ms'] = round(self.duration_ms, 3)
+        return d
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return '<Span %s>' % (self.to_dict(),)
+
+
+class TraceRing:
+    """A bounded ring of recent spans: appends evict the oldest entry
+    once ``capacity`` is reached, so memory is fixed regardless of op
+    volume."""
+
+    def __init__(self, capacity: int = 256):
+        assert capacity > 0, capacity
+        self.capacity = capacity
+        self._ring: collections.deque[Span] = collections.deque(
+            maxlen=capacity)
+        self._ids = itertools.count(1)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def start(self, op: str, path: str | None = None,
+              kind: str = 'op') -> Span:
+        span = Span(next(self._ids), op, path, kind=kind)
+        self._ring.append(span)
+        return span
+
+    def note(self, op: str, path: str | None = None,
+             zxid: int | None = None, kind: str = 'event',
+             **fields) -> Span:
+        """Record an instantaneous event (notification delivery, state
+        edge) as a zero-duration span."""
+        span = self.start(op, path, kind=kind)
+        for name, val in fields.items():
+            setattr(span, name, val)
+        span.finish(zxid=zxid)
+        return span
+
+    def spans(self) -> list[Span]:
+        return list(self._ring)
+
+    def dump(self) -> list[dict]:
+        """The ring's contents, oldest first, as JSON-ready dicts."""
+        return [s.to_dict() for s in self._ring]
+
+    def dump_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.dump(), indent=indent)
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+
+def format_spans(spans: list[dict], limit: int | None = None) -> str:
+    """Render dumped spans as aligned text lines for failure reports
+    (newest-last; ``limit`` keeps assertion messages bounded)."""
+    if limit is not None and len(spans) > limit:
+        spans = spans[-limit:]
+    lines = []
+    for s in spans:
+        dur = ('%8.2fms' % s['duration_ms']
+               if s.get('duration_ms') is not None else '      open')
+        lines.append(
+            '  #%-4d %-12s xid=%-6s zxid=%-6s %-7s %s %s%s'
+            % (s['span'], s['op'], s.get('xid', '-'),
+               s.get('zxid', '-'), s['status'], dur,
+               s.get('path') or '',
+               (' [%s]' % s['error']) if s.get('error') else ''))
+    return '\n'.join(lines)
