@@ -6,7 +6,8 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench asan chaos obs coverage clean
+.PHONY: all test check native bench asan chaos chaos-ensemble obs \
+    coverage clean
 
 all: check test
 
@@ -21,6 +22,16 @@ test: native
 chaos:
 	ZKSTREAM_CHAOS_SCHEDULES=$${ZKSTREAM_CHAOS_SCHEDULES:-60} \
 	    $(PYTHON) -m pytest tests/test_chaos.py -q -m 'not slow'
+
+# Ensemble-tier chaos, bounded slice: member kills/restarts,
+# replication partitions, session migration + the history-checked
+# invariant engine (io/invariants.py).  `-m 'not slow'` keeps the
+# full >=100-schedule campaign out of this target (it runs under the
+# slow marker: pytest tests/test_chaos_ensemble.py -m slow).  Rerun a
+# failing seed with `python -m zkstream_tpu chaos --tier ensemble
+# --seed N`; scale with ZKSTREAM_CHAOS_ENS_TIER1 / _SEED.
+chaos-ensemble:
+	$(PYTHON) -m pytest tests/test_chaos_ensemble.py -q -m 'not slow'
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), xid-correlated op tracing, and the four-letter admin

@@ -764,9 +764,16 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
 
     loop = asyncio.get_running_loop()
     srv = await ZKServer().start()
+    # one shared collector: every client's per-op latency lands in the
+    # same zookeeper_op_latency_ms histogram, scraped into the result
+    # below so BENCH_*.json carries histogram-derived p50/p99 per op
+    # next to the workload-timed percentiles
+    from zkstream_tpu.utils.metrics import Collector
+    collector = Collector()
     clients = [Client(address='127.0.0.1', port=srv.port,
                       session_timeout=30000, ingest=ingest,
-                      use_native_codec=use_native)
+                      use_native_codec=use_native,
+                      collector=collector)
                for _ in range(n_clients)]
     for c in clients:
         c.start()
@@ -873,6 +880,27 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
             # 'ingest'-labeled numbers are honest about it
             out['ingest_warming_ticks'] = ingest.ticks_warming
             out['ingest_frames'] = ingest.frames_routed
+
+        # Per-op latency distribution from the production histogram
+        # (zookeeper_op_latency_ms, every completion path, warm-up
+        # and watch re-arm reads included): the same series a scrape
+        # of a live deployment shows, published alongside the
+        # workload-timed percentiles above so the two views are
+        # cross-checkable in BENCH_*.json.
+        hist = collector.get_collector('zookeeper_op_latency_ms')
+        ops_hist = {}
+        for key in hist.label_keys():
+            labels = dict(key)
+            opname = labels.get('op', '')
+            n = hist.count(labels)
+            if not n:
+                continue
+            ops_hist[opname.lower()] = {
+                'count': n,
+                'p50_ms': round(hist.percentile(50, labels), 3),
+                'p99_ms': round(hist.percentile(99, labels), 3),
+            }
+        out['op_latency_hist'] = ops_hist
     finally:
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()

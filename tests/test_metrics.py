@@ -263,3 +263,34 @@ def test_gauge_name_collision_raises():
     assert 'b_zkstream_ingest_ticks 0' in text
     # gauges are reachable through the same lookup as counters
     assert col.get_collector('b_zkstream_ingest_ticks') is not None
+
+
+def test_histogram_percentile_interpolation():
+    """Histogram percentiles interpolate inside the bucket that holds
+    the rank (the histogram_quantile rule), clamp at the largest
+    finite bound for +Inf samples, and NaN on empty series — the
+    estimator bench.py publishes per-op p50/p99 through."""
+    import math
+
+    from zkstream_tpu.utils.metrics import Histogram
+
+    h = Histogram('t_ms', buckets=(1.0, 10.0, 100.0))
+    assert math.isnan(h.percentile(50))
+    for _ in range(50):
+        h.observe(0.5)               # <= 1.0 bucket
+    for _ in range(50):
+        h.observe(50.0)              # <= 100.0 bucket
+    # rank 50 sits exactly at the top of the first bucket
+    assert h.percentile(50) == pytest.approx(1.0)
+    # rank 75 is halfway through the (10, 100] bucket
+    assert h.percentile(75) == pytest.approx(55.0)
+    h2 = Histogram('t2_ms', buckets=(1.0, 10.0))
+    h2.observe(1000.0)               # +Inf-only sample
+    assert h2.percentile(99) == pytest.approx(10.0)  # clamped
+    # labelled series are independent
+    h3 = Histogram('t3_ms', buckets=(1.0, 10.0))
+    h3.observe(0.2, {'op': 'GET'})
+    h3.observe(8.0, {'op': 'SET'})
+    assert h3.percentile(50, {'op': 'GET'}) <= 1.0
+    assert h3.percentile(50, {'op': 'SET'}) > 1.0
+    assert {dict(k)['op'] for k in h3.label_keys()} == {'GET', 'SET'}

@@ -299,17 +299,27 @@ def build_parser() -> argparse.ArgumentParser:
         'chaos',
         help='run seeded fault-injection schedules against an '
              'in-process server and verify the resilience invariants')
+    ch.add_argument('--tier', choices=('transport', 'ensemble'),
+                    default='transport',
+                    help='transport: byte/socket faults against one '
+                         'server; ensemble: member kills/restarts, '
+                         'replication partitions and session '
+                         'migration with the history-checked '
+                         'invariant engine (io/invariants.py)')
     ch.add_argument('--seed', type=int, default=0,
                     help='base seed; schedule i uses seed+i (default 0)')
     ch.add_argument('--schedules', type=int, default=20,
                     help='number of consecutive seeded schedules')
-    ch.add_argument('--ops', type=int, default=6,
-                    help='client ops per schedule')
+    ch.add_argument('--ops', type=int, default=None,
+                    help='client ops per schedule (default 6 for '
+                         'transport, 12 plan steps for ensemble)')
     ch.add_argument('--quiet', action='store_true',
                     help='only print failing schedules + the summary')
     ch.add_argument('--trace-out', metavar='PATH', default=None,
                     help='write every schedule\'s xid-correlated span '
-                         'dump as JSON to PATH for offline triage')
+                         'dump — member kill/restart events included '
+                         'on the ensemble tier — as JSON to PATH for '
+                         'offline triage')
     return p
 
 
@@ -355,10 +365,13 @@ async def _admin(args) -> int:
 async def _chaos(args) -> int:
     """Drive the seeded chaos campaign (io/faults.py) and report.
     Exit 0 when every schedule's invariants held, 1 otherwise; each
-    line carries the seed, so any failure reruns with --seed N — and
-    arrives with its xid-correlated span dump (utils/trace.py), so
-    the failing interleaving is visible without log grepping."""
-    from .io.faults import run_campaign
+    line carries the seed, so any failure reruns with --seed N
+    (--tier ensemble for the failover tier) — and arrives with its
+    xid-correlated span dump (utils/trace.py) plus, on the ensemble
+    tier, the member-event timeline, so the failing interleaving is
+    visible without log grepping."""
+    from .io.faults import run_campaign, run_ensemble_campaign
+    from .io.invariants import format_history
     from .utils.trace import format_spans
 
     def progress(r):
@@ -366,23 +379,43 @@ async def _chaos(args) -> int:
             return
         status = 'ok ' if r.ok else 'FAIL'
         print('seed %6d  %s  ops=%d acked=%d typed_errs=%d '
-              'deadline=%d faults=%d watch_fires=%d'
+              'deadline=%d faults=%d watch_fires=%d%s'
               % (r.seed, status, r.ops, r.acked, r.typed_errors,
-                 r.deadline_errors, r.faults, r.watch_fires))
+                 r.deadline_errors, r.faults, r.watch_fires,
+                 '' if r.tier == 'transport'
+                 else ' member_events=%d' % (len(r.member_events),)))
         for v in r.violations:
             print('    violation: %s' % (v,))
+        if not r.ok and r.history:
+            timeline = format_history(r.history)
+            if timeline:
+                print('  member-event timeline:')
+                print(timeline)
         if not r.ok and r.trace:
             print('  span ring (oldest first):')
             print(format_spans(r.trace))
 
-    results = await run_campaign(args.seed, args.schedules,
-                                 ops=args.ops, progress=progress)
+    if args.tier == 'ensemble':
+        results = await run_ensemble_campaign(
+            args.seed, args.schedules,
+            ops=args.ops if args.ops is not None else 12,
+            progress=progress)
+    else:
+        results = await run_campaign(
+            args.seed, args.schedules,
+            ops=args.ops if args.ops is not None else 6,
+            progress=progress)
     if args.trace_out:
         import json
         with open(args.trace_out, 'w') as f:
-            json.dump([{'seed': r.seed, 'ok': r.ok,
-                        'violations': r.violations, 'trace': r.trace}
-                       for r in results], f, indent=2)
+            # member kill/restart events ride the span ring (kind
+            # 'member') AND the structured history; bytes payloads in
+            # history records serialize via repr
+            json.dump([{'seed': r.seed, 'ok': r.ok, 'tier': r.tier,
+                        'violations': r.violations,
+                        'member_events': r.member_events,
+                        'trace': r.trace, 'history': r.history}
+                       for r in results], f, indent=2, default=repr)
         print('span dumps written to %s' % (args.trace_out,))
     bad = [r for r in results if not r.ok]
     print('%d/%d schedules ok (%d faults injected, %d typed errors, '
@@ -392,8 +425,9 @@ async def _chaos(args) -> int:
              sum(r.typed_errors for r in results),
              sum(r.deadline_errors for r in results)))
     if bad:
-        print('failing seeds: %s' % (', '.join(str(r.seed)
-                                               for r in bad),),
+        print('failing seeds (rerun: python -m zkstream_tpu chaos '
+              '--tier %s --seed N --schedules 1): %s'
+              % (args.tier, ', '.join(str(r.seed) for r in bad)),
               file=sys.stderr)
         return 1
     return 0

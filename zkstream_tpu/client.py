@@ -138,6 +138,13 @@ class Client(FSM):
         #: dump it on failure.
         self.trace = trace if trace is not None else TraceRing(
             trace_capacity)
+        #: Optional per-op completion hook: called with the settled
+        #: Span after EVERY completion path (reply, typed error,
+        #: deadline), in completion order.  The chaos campaigns'
+        #: history engine (io/invariants.py) subscribes here so the
+        #: recorded history cannot diverge from what the client
+        #: actually observed; None in production.
+        self.on_op = None
 
         self.session_timeout = session_timeout
         self.session: ZKSession | None = None
@@ -444,6 +451,8 @@ class Client(FSM):
         finally:
             self._op_latency.observe(
                 (time.monotonic() - t0) * 1000.0, {'op': opcode})
+            if self.on_op is not None and span is not None:
+                self.on_op(span)
 
     async def ping(self, deadline=_USE_DEFAULT) -> float:
         """Round-trip a ping; resolves to the latency in ms."""

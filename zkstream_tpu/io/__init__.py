@@ -3,7 +3,8 @@ L4/L4'/L5: lib/connection-fsm.js, lib/zk-session.js, cueball)."""
 
 from .backoff import Backoff, BackoffPolicy  # noqa: F401
 from .connection import Backend, ZKConnection, ZKRequest  # noqa: F401
-from .faults import FaultConfig, FaultInjector  # noqa: F401
+from .faults import FaultConfig, FaultInjector, FaultPlan  # noqa: F401
+from .invariants import History, check_history  # noqa: F401
 from .pool import ConnectionPool, RecoveryPolicy  # noqa: F401
 from .session import ZKSession  # noqa: F401
 from .watcher import LostWakeupError, ZKWatcher, ZKWatchEvent  # noqa: F401

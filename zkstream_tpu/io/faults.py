@@ -45,13 +45,20 @@ import hashlib
 import random
 import struct
 
+from ..protocol.errors import (
+    ZKError,
+    ZKNotConnectedError,
+    ZKProtocolError,
+)
 from ..utils.aio import ambient_loop
+from .invariants import AMBIGUOUS_CODES
 
 #: Decision streams, one seeded RNG each.  'plan' is reserved for the
 #: campaign driver's op/crash scheduling so workload choices never
-#: perturb transport-fault draws.
+#: perturb transport-fault draws; 'ingest' drives the FleetIngest
+#: batched drain's tick-time faults.
 CATEGORIES = ('connect', 'rx', 'tx', 'accept', 'server_tx',
-              'partition', 'plan')
+              'partition', 'plan', 'ingest')
 
 
 class InjectedRefusal(ConnectionRefusedError):
@@ -82,6 +89,11 @@ class FaultConfig:
     server_tx_delay_ms: tuple[float, float] = (0.0, 10.0)
     # replication: leader -> follower push drop (asymmetric partition)
     p_push_drop: float = 0.0
+    # FleetIngest batched drain: tick-time faults (io/ingest.py) — a
+    # slot suffix withheld across a tick boundary (partial frame into
+    # the device scan) or a connection reset at tick time
+    p_ingest_hold: float = 0.0
+    p_ingest_reset: float = 0.0
     #: stop firing after this many injected faults (None = unbounded);
     #: the budget is what makes randomized campaigns converge
     max_faults: int | None = 8
@@ -105,6 +117,25 @@ class FaultConfig:
         cfg.rx_delay_ms = (0.5, rng.uniform(2.0, 20.0))
         cfg.server_tx_delay_ms = (0.0, rng.uniform(1.0, 8.0))
         cfg.max_faults = rng.randint(1, 5)
+        return cfg
+
+    @classmethod
+    def randomized_ensemble(cls, seed: int) -> 'FaultConfig':
+        """The ensemble campaign's fault mix: the transport mix of
+        :meth:`randomized` (drawn from the same stream, so the two
+        tiers' transport schedules stay comparable per seed) plus
+        ingest tick faults, drawn from a separate stream so adding
+        them never perturbed the transport tier's existing
+        schedules."""
+        cfg = cls.randomized(seed)
+        rng = random.Random('cfg-ens/%d' % (seed,))
+        if rng.random() < 0.5:
+            cfg.p_ingest_hold = rng.uniform(0.05, 0.6)
+        if rng.random() < 0.25:
+            cfg.p_ingest_reset = rng.uniform(0.02, 0.10)
+        # member kills dominate the ensemble tier; give the byte-level
+        # faults a slightly larger budget so both layers keep firing
+        cfg.max_faults = rng.randint(2, 8)
         return cfg
 
 
@@ -380,6 +411,25 @@ class FaultInjector:
         return self._take('partition', self.config.p_push_drop,
                           'drop push to follower %s' % (follower_token,))
 
+    # -- FleetIngest batched drain (tick-time faults) --
+
+    def ingest_reset(self, conn) -> bool:
+        """Kill this connection at the tick boundary (teardown while
+        other streams of the same batch still route)."""
+        return self._take('ingest', self.config.p_ingest_reset,
+                          'ingest tick reset')
+
+    def ingest_cut(self, conn, nbytes: int) -> int:
+        """How many trailing bytes of a slot to withhold from this
+        tick (0 = none): the device scan sees a partial frame at an
+        arbitrary cut and must finish it on the follow-up tick."""
+        if nbytes < 2:
+            return 0
+        if not self._take('ingest', self.config.p_ingest_hold,
+                          'ingest tick hold'):
+            return 0
+        return self._streams['ingest'].randrange(1, nbytes)
+
 
 # ---------------------------------------------------------------------
 # Campaign driver: one seeded schedule end to end.  Shared by
@@ -396,6 +446,36 @@ CAMPAIGN_OP_DEADLINE_MS = 400
 CAMPAIGN_OP_HARD_S = 4.0
 
 
+async def _bounded_op(res: 'ScheduleResult', coro, what: str,
+                      on_ambiguous=None):
+    """Run one campaign op under the hard bound; returns
+    ``(acked, result)``.  Shared by both campaign tiers so the typed-
+    error tally, deadline counting and the silent-hang violation
+    cannot drift between them.  ``on_ambiguous`` (ensemble tier) is
+    called when the op was sent but its outcome is unknown."""
+    try:
+        return True, await asyncio.wait_for(coro, CAMPAIGN_OP_HARD_S)
+    except ZKNotConnectedError:
+        res.typed_errors += 1        # raised before any send: the op
+        return False, None           # definitely did not apply
+    except (ZKError, ZKProtocolError) as e:
+        res.typed_errors += 1
+        code = getattr(e, 'code', '')
+        if code == 'DEADLINE_EXCEEDED':
+            res.deadline_errors += 1
+        if on_ambiguous is not None and code in AMBIGUOUS_CODES:
+            on_ambiguous()
+        return False, None
+    except (asyncio.TimeoutError, TimeoutError):
+        res.violations.append(
+            '%s hung past the %.1fs hard bound (deadline %d ms '
+            'never fired)' % (what, CAMPAIGN_OP_HARD_S,
+                              CAMPAIGN_OP_DEADLINE_MS))
+        if on_ambiguous is not None:
+            on_ambiguous()
+        return False, None
+
+
 @dataclasses.dataclass
 class ScheduleResult:
     seed: int
@@ -410,6 +490,18 @@ class ScheduleResult:
     #: after the schedule: on a violation this is the exact
     #: request/reply/notification interleaving that produced it.
     trace: list = dataclasses.field(default_factory=list)
+    #: Which campaign tier produced this result ('transport' or
+    #: 'ensemble').
+    tier: str = 'transport'
+    #: Ensemble tier only: the member-event timeline (kill / restart /
+    #: partition / heal / lag / migrate), in schedule order — printed
+    #: next to the seed on failure so the failing interleaving of
+    #: member churn is visible without rerunning.
+    member_events: list = dataclasses.field(default_factory=list)
+    #: Ensemble tier only: the full op/ack/watch/member history the
+    #: invariant engine (io/invariants.py) checked, as JSON-ready
+    #: dicts.
+    history: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -438,7 +530,6 @@ async def run_schedule(seed: int, ops: int = 6,
       same mzxid.
     """
     from ..client import Client
-    from ..protocol.errors import ZKError, ZKProtocolError
     from ..server.server import ZKServer
     from ..server.store import ZKOpError
     from .backoff import BackoffPolicy
@@ -463,20 +554,8 @@ async def run_schedule(seed: int, ops: int = 6,
     fires: list[int] = []              # dataChanged mzxids
 
     async def bounded(coro, what):
-        """Run one op under the hard bound; returns (ok, result)."""
-        try:
-            return True, await asyncio.wait_for(coro, CAMPAIGN_OP_HARD_S)
-        except (ZKError, ZKProtocolError) as e:
-            res.typed_errors += 1
-            if getattr(e, 'code', '') == 'DEADLINE_EXCEEDED':
-                res.deadline_errors += 1
-            return False, None
-        except asyncio.TimeoutError:
-            res.violations.append(
-                '%s hung past the %.1fs hard bound (deadline %d ms '
-                'never fired)' % (what, CAMPAIGN_OP_HARD_S,
-                                  CAMPAIGN_OP_DEADLINE_MS))
-            return False, None
+        """Run one op under the shared hard bound (_bounded_op)."""
+        return await _bounded_op(res, coro, what)
 
     try:
         try:
@@ -606,6 +685,490 @@ async def run_campaign(base_seed: int, schedules: int,
     out = []
     for i in range(schedules):
         r = await run_schedule(base_seed + i, ops=ops)
+        out.append(r)
+        if progress is not None:
+            progress(r)
+    return out
+
+
+# ---------------------------------------------------------------------
+# Ensemble tier: deterministic failover campaigns.  One seeded
+# FaultPlan schedules member kills/restarts, replication partitions,
+# follower lag and forced session migration AROUND a concurrent client
+# workload whose every op lands in an append-only history; the
+# invariant engine (io/invariants.py) replays the history afterwards.
+# Shared by tests/test_chaos_ensemble.py and ``chaos --tier ensemble``.
+# ---------------------------------------------------------------------
+
+#: The workload/member-event mix one plan step draws from ('plan'
+#: stream; repetition = weight): 13 op entries vs 10 member-churn
+#: entries (~60/40), so most schedules see several ops land *between*
+#: failures while member events still dominate the fault surface.
+PLAN_ACTIONS = (
+    'set', 'set', 'set', 'get', 'get', 'list', 'sync',
+    'create', 'create', 'create_seq', 'create_seq', 'create_eph',
+    'delete',
+    'kill_serving', 'kill_follower', 'kill_leader', 'kill_during_op',
+    'restart', 'restart',
+    'partition', 'partition', 'lag', 'migrate',
+)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One ensemble schedule's deterministic shape: everything about
+    the campaign that is fixed before the first byte flows.  The
+    step-by-step decisions (which action, which victim) are drawn at
+    runtime from the injector's 'plan' stream, so plan + seed fully
+    determine the schedule."""
+
+    seed: int
+    config: FaultConfig
+    ops: int = 12
+    #: client-facing members: 1 leader + (members - 1) replica-store
+    #: followers (one shared leader database, killable listeners)
+    members: int = 3
+    session_timeout: int = 6000
+    #: 'none' | 'direct' (pass-through regime) | 'batch' (device
+    #: drain, bypass_bytes=0) — which receive path the client runs
+    ingest_mode: str = 'none'
+    #: decoherence interval, ms (None = production default): small
+    #: values force live session migration back toward the leader
+    #: mid-schedule
+    decoherence_ms: int | None = None
+
+    @classmethod
+    def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
+        rng = random.Random('plan/%d' % (seed,))
+        return cls(
+            seed=seed,
+            config=FaultConfig.randomized_ensemble(seed),
+            ops=ops,
+            session_timeout=rng.choice([2000, 4000, 8000]),
+            ingest_mode=rng.choice(['none', 'none', 'direct',
+                                    'batch']),
+            decoherence_ms=rng.choice([None, None, 50, 120]))
+
+
+class EnsembleUnderTest:
+    """The campaign's ensemble: a ``ZKEnsemble`` (member 0 = leader
+    endpoint; followers serve from their own ReplicaStore, so they
+    genuinely lag when told to) composed — not subclassed, so the
+    client-side io package keeps its lazy server imports — with
+    dead-member tracking, a ReplicationService, and one
+    cross-process-protocol replica: a RemoteLeader mirror over real
+    TCP through server/replication.py that the plan partitions and
+    heals.  Member lifecycle (start/kill/restart/lag) delegates to
+    the ZKEnsemble, so the two harnesses cannot drift.
+
+    The replica does not serve clients: a RemoteLeader forwards writes
+    over a *blocking* control socket, and with every member on the one
+    campaign event loop that RPC would deadlock against the
+    ReplicationService it is calling (the OS-process tier exists
+    precisely because of this — tests/process_member_worker.py); the
+    SIGKILL acceptance test keeps that tier covered.  Here the replica
+    is the partition target, and its convergence with the leader after
+    heal + sync barrier is one of the campaign's checks."""
+
+    def __init__(self, members: int = 3):
+        from ..server.replication import ReplicationService
+        from ..server.server import ZKEnsemble
+
+        self._ens = ZKEnsemble(members, lag=0.0)
+        self.db = self._ens.db
+        self.servers = self._ens.servers
+        self.svc = ReplicationService(self.db)
+        self.dead: set[int] = set()
+        self.remote = None           # RemoteLeader (events/control)
+        self.replica = None          # RemoteReplicaStore over it
+
+    async def start(self) -> 'EnsembleUnderTest':
+        from ..server.replication import (
+            RemoteLeader,
+            RemoteReplicaStore,
+        )
+
+        await self._ens.start()
+        await self.svc.start()
+        self.remote = await RemoteLeader('127.0.0.1',
+                                         self.svc.port).connect()
+        self.replica = RemoteReplicaStore(self.remote, lag=0.0)
+        return self
+
+    def install_faults(self, inj: FaultInjector) -> None:
+        self._ens.install_faults(inj)
+        self.svc.faults = inj
+
+    def addresses(self) -> list[tuple[str, int]]:
+        return self._ens.addresses()
+
+    def live(self) -> list[int]:
+        return [i for i in range(len(self.servers))
+                if i not in self.dead]
+
+    async def kill(self, idx: int) -> None:
+        await self._ens.kill(idx)
+        self.dead.add(idx)
+
+    async def restart(self, idx: int) -> None:
+        await self._ens.restart(idx)
+        self.dead.discard(idx)
+
+    def set_lag(self, idx: int, lag: float | None) -> None:
+        """Delayed follower catch-up: None parks the follower's
+        replica until the next write/sync through it; restoring a
+        non-positive lag applies the parked backlog immediately."""
+        self._ens.set_lag(idx, lag)
+        if lag is not None and lag <= 0:
+            self.servers[idx].store.catch_up()
+
+    def partition_replica(self) -> bool:
+        """Toggle the scheduled asymmetric partition of the TCP
+        replica; returns True when now partitioned."""
+        token = self.remote.token
+        if token in self.svc.partitioned:
+            self.svc.partitioned.discard(token)
+            return False
+        self.svc.partitioned.add(token)
+        return True
+
+    def heal(self) -> None:
+        self.svc.partitioned.clear()
+
+    async def stop(self) -> None:
+        if self.remote is not None:
+            self.remote.close()
+        await self._ens.stop()
+        await self.svc.stop()
+
+
+async def run_ensemble_schedule(seed: int, ops: int = 12,
+                                collector=None,
+                                plan: FaultPlan | None = None
+                                ) -> ScheduleResult:
+    """Run one seeded ensemble-tier schedule: member churn around a
+    concurrent client workload, every op recorded into an append-only
+    history, then the five history invariants (io/invariants.py)
+    checked against the leader's final database.  Any failure is
+    reproducible with ``python -m zkstream_tpu chaos --tier ensemble
+    --seed N``."""
+    from ..client import Client
+    from ..protocol.consts import CreateFlag
+    from .backoff import BackoffPolicy
+    from .invariants import History, check_ephemerals, check_history
+    from .pool import DEFAULT_DECOHERENCE_INTERVAL
+
+    if plan is None:
+        plan = FaultPlan.randomized(seed, ops=ops)
+    inj = FaultInjector(seed, plan.config)
+    res = ScheduleResult(seed=seed, tier='ensemble')
+    h = History()
+
+    ens = await EnsembleUnderTest(plan.members).start()
+    ens.install_faults(inj)
+
+    ingest = None
+    if plan.ingest_mode != 'none':
+        from .ingest import FleetIngest
+        ingest = FleetIngest(
+            body_mode='host', max_frames=8,
+            bypass_bytes=0 if plan.ingest_mode == 'batch' else 16384)
+        ingest.faults = inj
+
+    client = Client(
+        servers=ens.addresses(), shuffle_backends=False,
+        session_timeout=plan.session_timeout, seed=seed, faults=inj,
+        op_timeout=CAMPAIGN_OP_DEADLINE_MS, collector=collector,
+        ingest=ingest, trace_capacity=512,
+        decoherence_interval=(plan.decoherence_ms
+                              if plan.decoherence_ms is not None
+                              else DEFAULT_DECOHERENCE_INTERVAL),
+        connect_policy=BackoffPolicy(timeout=400, retries=2,
+                                     delay=30, cap=200),
+        default_policy=BackoffPolicy(timeout=400, retries=3,
+                                     delay=50, cap=400))
+
+    def on_op(span):
+        h.op(span.op, span.path, status=span.status, zxid=span.zxid,
+             session_id=int(span.session_id, 16)
+             if span.session_id else 0,
+             error=span.error)
+    client.on_op = on_op
+    client.on('expire', lambda: h.session_event(
+        'expired', client.session.session_id
+        if client.session is not None else 0))
+    client.start()
+
+    def note_member(event: str, member) -> None:
+        h.member_event(event, member)
+        client.trace.note('MEMBER_' + event.upper(),
+                          path='member:%s' % (member,), kind='member')
+
+    def sid() -> int:
+        for r in reversed(h.records):
+            if r['kind'] == 'op':
+                return r['session_id']
+        return 0
+
+    async def bounded(coro, what, op=None, path=None, seq_parent=None):
+        """One op under the shared hard bound (_bounded_op); writes
+        with an unknown outcome are recorded as ambiguous."""
+        on_amb = None
+        if op is not None:
+            def on_amb():
+                h.ambiguous(op, path, session_id=sid(),
+                            sequential_parent=seq_parent)
+        return await _bounded_op(res, coro, what, on_amb)
+
+    async def do_create(path, data, flags=0, seq_parent=None):
+        ok, made = await bounded(
+            client.create(path, data, flags=flags),
+            'create %s' % path, op='create', path=path,
+            seq_parent=seq_parent)
+        if ok:
+            res.acked += 1
+            h.acked_create(made, data, sid(),
+                           ephemeral=bool(CreateFlag(flags)
+                                          & CreateFlag.EPHEMERAL),
+                           sequential_parent=seq_parent)
+        return ok, made
+
+    async def wait_usable(timeout: float) -> bool:
+        if client.is_connected():
+            return True
+        try:
+            await client.wait_connected(timeout=timeout,
+                                        fail_fast=False)
+            return True
+        except (asyncio.TimeoutError, TimeoutError):
+            return False
+
+    fires: list = []
+    created: list[str] = []          # deletable acked paths
+    set_idx = 0
+    try:
+        if not await wait_usable(10):
+            res.violations.append(
+                'never connected within 10s (fault budget %r should '
+                'have exhausted)' % (inj.config.max_faults,))
+            return res
+
+        client.watcher('/w').on(
+            'dataChanged',
+            lambda data, stat: (fires.append(stat.mzxid),
+                                h.watch_fire('/w', 'dataChanged',
+                                             stat.mzxid)))
+        client.watcher('/').on(
+            'childrenChanged',
+            lambda ch, stat: h.watch_fire('/', 'childrenChanged',
+                                          stat.pzxid))
+
+        # bootstrap nodes the workload mutates; a failed bootstrap is
+        # fine — the dependent ops surface typed errors
+        ok, _ = await do_create('/w', b'v0')
+        if ok:
+            h.acked_set('/w', 0, sid())
+        await do_create('/seq', b'')
+
+        for i in range(plan.ops):
+            await wait_usable(1.5)
+            res.ops += 1
+            act = inj.choice('plan', PLAN_ACTIONS)
+            if act == 'set':
+                set_idx += 1
+                ok, _ = await bounded(
+                    client.set('/w', b'v%d' % set_idx, version=-1),
+                    'set /w v%d' % set_idx, op='set', path='/w')
+                if ok:
+                    res.acked += 1
+                    h.acked_set('/w', set_idx, sid())
+            elif act == 'create':
+                ok, made = await do_create('/c%d' % i, b'd%d' % i)
+                if ok:
+                    created.append(made)
+            elif act == 'create_seq':
+                await do_create('/seq/n-', b's%d' % i,
+                                flags=CreateFlag.SEQUENTIAL,
+                                seq_parent='/seq')
+            elif act == 'create_eph':
+                await do_create('/e%d' % i, b'e%d' % i,
+                                flags=CreateFlag.EPHEMERAL)
+            elif act == 'delete':
+                if not created:
+                    continue
+                path = inj.choice('plan', created)
+                ok, _ = await bounded(client.delete(path, -1),
+                                      'delete %s' % path,
+                                      op='delete', path=path)
+                if ok:
+                    res.acked += 1
+                    h.acked_delete(path, sid())
+                    created.remove(path)
+            elif act == 'get':
+                await bounded(client.get('/w'), 'get /w')
+            elif act == 'list':
+                await bounded(client.list('/'), 'list /')
+            elif act == 'sync':
+                await bounded(client.sync('/w'), 'sync /w',
+                              op='sync', path='/w')
+            elif act in ('kill_serving', 'kill_during_op'):
+                conn = client.current_connection()
+                live = ens.live()
+                if conn is None or len(live) <= 1:
+                    continue
+                victim = next((j for j in live
+                               if ens.servers[j].port ==
+                               conn.backend.port), None)
+                if victim is None:
+                    continue
+                if act == 'kill_during_op':
+                    set_idx += 1
+                    inflight = asyncio.get_running_loop().create_task(
+                        client.set('/w', b'v%d' % set_idx,
+                                   version=-1))
+                    await asyncio.sleep(0.003)
+                    note_member('kill-mid-op', victim)
+                    await ens.kill(victim)
+                    ok, _ = await bounded(
+                        inflight, 'mid-kill set /w v%d' % set_idx,
+                        op='set', path='/w')
+                    if ok:
+                        res.acked += 1
+                        h.acked_set('/w', set_idx, sid())
+                else:
+                    note_member('kill', victim)
+                    await ens.kill(victim)
+            elif act == 'kill_follower':
+                live = [j for j in ens.live() if j != 0]
+                if not live or len(ens.live()) <= 1:
+                    continue
+                victim = inj.choice('plan', live)
+                note_member('kill', victim)
+                await ens.kill(victim)
+            elif act == 'kill_leader':
+                if 0 in ens.dead or len(ens.live()) <= 1:
+                    continue
+                note_member('kill', 0)
+                await ens.kill(0)
+            elif act == 'restart':
+                if not ens.dead:
+                    continue
+                back = inj.choice('plan', sorted(ens.dead))
+                note_member('restart', back)
+                await ens.restart(back)
+            elif act == 'partition':
+                if ens.partition_replica():
+                    note_member('partition', 'replica')
+                else:
+                    note_member('heal', 'replica')
+            elif act == 'lag':
+                idx = inj.choice('plan',
+                                 range(1, len(ens.servers)))
+                lag = inj.choice('plan', (None, 0.05, 0.0))
+                note_member('lag=%r' % (lag,), idx)
+                ens.set_lag(idx, lag)
+            else:
+                assert act == 'migrate', act
+                note_member('migrate', '-')
+                client.pool.rebalance_now()
+
+        # -- verification: faults off, ensemble healed --------------
+        inj.stop()
+        ens.heal()
+        for back in sorted(ens.dead):
+            note_member('restart', back)
+            await ens.restart(back)
+        for j in range(1, len(ens.servers)):
+            ens.set_lag(j, 0.0)
+        if not await wait_usable(10):
+            res.violations.append(
+                'never reconnected after every member was restarted '
+                'and faults stopped')
+        else:
+            await bounded(client.sync('/w'), 'final sync /w',
+                          op='sync', path='/w')
+        # the TCP replica must converge once partitions heal: the
+        # sync barrier rides the (never-partitioned) control channel
+        try:
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(
+                    None, ens.replica.sync_flush), 5)
+        except (asyncio.TimeoutError, TimeoutError):
+            res.violations.append(
+                'replica sync barrier hung after partitions healed')
+        else:
+            if ens.replica.zxid != ens.db.zxid:
+                res.violations.append(
+                    'replica did not converge after heal: replica '
+                    'zxid %d, leader zxid %d'
+                    % (ens.replica.zxid, ens.db.zxid))
+            else:
+                diverged = [
+                    p for p in ens.db.nodes
+                    if p not in ens.replica.nodes
+                    or bytes(ens.replica.nodes[p].data)
+                    != bytes(ens.db.nodes[p].data)]
+                extra = [p for p in ens.replica.nodes
+                         if p not in ens.db.nodes]
+                if diverged or extra:
+                    res.violations.append(
+                        'replica tree diverged from leader at equal '
+                        'zxid %d: missing/stale %r, extra %r'
+                        % (ens.db.zxid, sorted(diverged)[:8],
+                           sorted(extra)[:8]))
+
+        res.watch_fires = len(fires)
+        res.violations.extend(check_history(h, ens.db))
+        return res
+    finally:
+        # stop injecting on every exit path (the never-connected early
+        # return included), and count fired faults only once quiet —
+        # the teardown below must not race new faults into the tally
+        # or past close()'s 5 s cap.  Each teardown step is guarded
+        # individually: a teardown bug is exactly the kind of failure
+        # this tier exists to surface, and it must still arrive with
+        # its seed, violations, span ring and member timeline — never
+        # abort the campaign or leak the ensemble's listeners.
+        inj.stop()
+        res.faults = len(inj.fired)
+        try:
+            await asyncio.wait_for(client.close(), 5)
+        except (asyncio.TimeoutError, TimeoutError):
+            client.pool.stop()
+            res.violations.append('client.close() hung past 5s')
+        except Exception as e:
+            client.pool.stop()
+            res.violations.append('client.close() raised: %r' % (e,))
+        else:
+            # confirmed close/expiry: ephemerals must not outlive it
+            # (only NEW findings — the pre-close check_history pass
+            # already reported anything visible before close)
+            res.violations.extend(
+                v for v in check_ephemerals(h, ens.db)
+                if v not in res.violations)
+        try:
+            await ens.stop()
+        except Exception as e:
+            res.violations.append('ensemble teardown raised: %r'
+                                  % (e,))
+        inj.close()
+        if ingest is not None:
+            ingest.close()
+        res.trace = client.trace.dump()
+        res.history = list(h.records)
+        # derived, never dual-appended: the history's member records
+        # ARE the timeline
+        res.member_events = h.member_timeline()
+
+
+async def run_ensemble_campaign(base_seed: int, schedules: int,
+                                ops: int = 12,
+                                progress=None) -> list[ScheduleResult]:
+    """Run ``schedules`` consecutive seeded ensemble schedules
+    starting at ``base_seed``."""
+    out = []
+    for i in range(schedules):
+        r = await run_ensemble_schedule(base_seed + i, ops=ops)
         out.append(r)
         if progress is not None:
             progress(r)

@@ -252,6 +252,25 @@ class FleetIngest:
         #: (Bp, L) buckets at once must not stack ~1 s XLA compiles
         #: concurrently on the host that is also serving scalar ticks
         self._warm_queue: queue.Queue | None = None
+        #: Optional seeded FaultInjector (io/faults.py): tick-time
+        #: faults in the BATCH regime — a slot's buffered suffix held
+        #: back across a tick boundary (the device scan must handle a
+        #: partial frame at an arbitrary cut and finish it next tick)
+        #: or a connection reset at tick time (teardown mid-batch:
+        #: unregister/restore_pending while other streams route).  In
+        #: the pass-through regime the per-connection rx gate already
+        #: owns byte-level faults — the drain there IS the scalar
+        #: codec — so these hooks fire only on the batched tick.
+        self.faults = None
+        #: id(conn) -> bytes withheld from the current tick by the
+        #: injector; re-appended after the tick routes (FIFO: the
+        #: suffix of a slot goes back to the same position).
+        self._held: dict[int, bytes] = {}
+        #: slots whose withheld suffix was just released: exempt from
+        #: a fresh hold for one tick, so the follow-up tick finishes
+        #: the partial frame instead of re-cutting the same bytes in
+        #: a busy loop until new data arrives
+        self._no_hold: set[int] = set()
 
     # -- connection registry --
 
@@ -272,6 +291,10 @@ class FleetIngest:
 
     def unregister(self, conn: 'ZKConnection') -> None:
         slot = self._slots.pop(id(conn), None)
+        self._no_hold.discard(id(conn))
+        held = self._held.pop(id(conn), None)
+        if held is not None and slot is not None:
+            slot[1].extend(held)     # withheld suffix rejoins in order
         # Return unprocessed bytes to the scalar decoder: the closing
         # state keeps draining replies through the codec.
         if slot is not None and slot[1] and conn.codec is not None:
@@ -780,7 +803,11 @@ class FleetIngest:
 
     def _flip_direct(self, active) -> None:
         """Batch -> pass-through: drain what the slots hold, hand each
-        codec its partial-frame residue, switch."""
+        codec its partial-frame residue, switch.  Fault-withheld
+        suffixes rejoin their slots FIRST — the direct regime never
+        drains slot buffers, so a tail left in ``_held`` across the
+        flip would strand, then reorder behind fresh rx bytes."""
+        self._release_held()
         for conn, buf in active:
             if id(conn) not in self._slots:
                 continue
@@ -832,9 +859,13 @@ class FleetIngest:
             if not still_direct:
                 self._flip_batch()
             return True
+        if self.faults is not None:
+            self._inject_tick_faults()
         active = [(conn, buf) for conn, buf in self._slots.values()
                   if buf and conn.is_in_state('connected')]
         if not active:
+            if self._release_held():
+                self._schedule()     # finish the withheld suffixes
             return False
         before = self.frames_routed
         try:
@@ -842,7 +873,48 @@ class FleetIngest:
         finally:
             self._note_frames(self.frames_routed - before)
             self._frames_mark = self.frames_routed
+            if self._release_held():
+                self._schedule()
         return True
+
+    def _inject_tick_faults(self) -> None:
+        """Apply the injector's tick-time decisions to the batch-regime
+        slots: a connection reset at the tick boundary, or a suffix of
+        a slot's buffered bytes withheld from this tick (a partial
+        frame at an arbitrary cut for the device scan to finish on the
+        follow-up tick)."""
+        fi = self.faults
+        for cid, (conn, buf) in list(self._slots.items()):
+            if not buf or not conn.is_in_state('connected'):
+                continue
+            if fi.ingest_reset(conn):
+                conn.emit('sockError', ConnectionResetError(
+                    'injected ingest tick reset'))
+                continue
+            if cid in self._no_hold:
+                self._no_hold.discard(cid)
+                continue
+            cut = fi.ingest_cut(conn, len(buf))
+            if cut:
+                self._held[cid] = \
+                    self._held.get(cid, b'') + bytes(buf[-cut:])
+                del buf[-cut:]
+
+    def _release_held(self) -> bool:
+        """Re-append every withheld suffix to its slot (in order);
+        True when any slot got bytes back (a follow-up tick is due)."""
+        if not self._held:
+            return False
+        released = False
+        held, self._held = self._held, {}
+        for cid, tail in held.items():
+            slot = self._slots.get(cid)
+            if slot is None:
+                continue             # conn died; its bytes die with it
+            slot[1].extend(tail)
+            self._no_hold.add(cid)
+            released = True
+        return released
 
     def _tick_inner(self, active) -> None:
         if self._want_direct():

@@ -1,0 +1,375 @@
+"""History-checked invariants for ensemble-tier chaos campaigns.
+
+The transport-tier campaign (io/faults.py ``run_schedule``) checks a
+handful of end-state facts inline.  The ensemble tier — member kills,
+restarts, partitions, session migration — needs more: whether an
+outcome is a bug depends on *when* it happened relative to failovers
+and session lifecycle, so the campaign records every operation,
+watch fire, member event and session edge into an append-only
+:class:`History`, and :func:`check_history` replays it after the
+schedule against the leader's final database.
+
+Invariants (each one a ``check_*`` function, composed by
+:func:`check_history`; every violation string stands alone so a
+failing seed's report reads without the source):
+
+1. **No acked-write loss across failover** — an acked create (with no
+   later acked delete) exists with its exact data; an acked delete
+   stays deleted; the newest acked set to the shared counter node is
+   <= the final value (a later *unacked* set may have applied:
+   at-least-once ambiguity).  An op that died with an outcome-unknown
+   error (CONNECTION_LOSS / DEADLINE_EXCEEDED / PING_TIMEOUT after
+   the request was sent) is recorded as *ambiguous* and weakens only
+   the expectations it could have changed.
+2. **Zxid monotonicity per session** — the reply zxids stamped on
+   successful *write* completions (CREATE / SET_DATA / DELETE / SYNC)
+   never decrease per session, in completion order.  Writes are
+   sequenced by the single leader and the serving member catches its
+   store up through the write before replying, so a decrease means a
+   reply was misrouted or a session resumed against state older than
+   it had already observed.
+3. **Ephemeral lifetime** — an ephemeral node exists exactly while
+   its owning session does: while the session is live it must be
+   present (unless acked- or ambiguously deleted); once the leader
+   confirms the session expired or closed it must be gone.
+4. **Sequential numbering** — acked SEQUENTIAL creates under a parent
+   get strictly increasing numbers in ack order, and the total of the
+   gaps is covered by the ambiguous sequential creates on that parent
+   (an outcome-unknown create may have consumed a number; nothing
+   else may).
+5. **Watch at-most-once per arm** — no watch event is delivered twice
+   for the same change: per (path, kind) no duplicated zxid, and at
+   most one 'deleted' per single-deletion path (re-arms over the same
+   absence stay silent).
+
+The history is plain data (a list of dicts) so it can ride a JSON
+trace dump next to the span ring; :func:`format_history` renders the
+member-event timeline for failure reports.
+"""
+
+from __future__ import annotations
+
+#: Opcodes whose successful replies must carry monotone zxids per
+#: session (leader-sequenced; the member catches up before replying).
+WRITE_OPS = frozenset(('CREATE', 'SET_DATA', 'DELETE', 'SYNC'))
+
+#: Error codes that leave a sent write's outcome unknown.
+AMBIGUOUS_CODES = frozenset(('CONNECTION_LOSS', 'DEADLINE_EXCEEDED',
+                             'PING_TIMEOUT'))
+
+
+class History:
+    """Append-only campaign history.  Every record is a dict with a
+    ``kind`` and a monotonically increasing ``t`` (history order —
+    completion order for ops, delivery order for watch fires)."""
+
+    def __init__(self) -> None:
+        self.records: list[dict] = []
+
+    def _add(self, kind: str, **fields) -> dict:
+        rec = {'kind': kind, 't': len(self.records)}
+        rec.update(fields)
+        self.records.append(rec)
+        return rec
+
+    # -- recorders --
+
+    def op(self, op: str, path: str | None, status: str,
+           zxid: int | None = None, session_id: int = 0,
+           error: str | None = None) -> dict:
+        """One completed client op (every completion path)."""
+        return self._add('op', op=op, path=path, status=status,
+                         zxid=zxid, session_id=session_id, error=error)
+
+    def acked_create(self, path: str, data: bytes, session_id: int,
+                     ephemeral: bool = False,
+                     sequential_parent: str | None = None) -> dict:
+        return self._add('ack', op='create', path=path, data=data,
+                         session_id=session_id, ephemeral=ephemeral,
+                         seq_parent=sequential_parent)
+
+    def acked_delete(self, path: str, session_id: int) -> dict:
+        return self._add('ack', op='delete', path=path,
+                         session_id=session_id)
+
+    def acked_set(self, path: str, index: int,
+                  session_id: int) -> dict:
+        return self._add('ack', op='set', path=path, index=index,
+                         session_id=session_id)
+
+    def ambiguous(self, op: str, path: str | None,
+                  session_id: int = 0,
+                  sequential_parent: str | None = None) -> dict:
+        """A write whose request was sent but whose outcome is
+        unknown (typed CONNECTION_LOSS / deadline / ping timeout)."""
+        return self._add('ambig', op=op, path=path,
+                         session_id=session_id,
+                         seq_parent=sequential_parent)
+
+    def watch_fire(self, path: str, event: str,
+                   zxid: int | None) -> dict:
+        return self._add('watch', path=path, event=event, zxid=zxid)
+
+    def member_event(self, event: str, member: int | str) -> dict:
+        """Ensemble-tier event: kill / restart / partition / heal /
+        lag / migrate."""
+        return self._add('member', event=event, member=member)
+
+    def session_event(self, event: str, session_id: int) -> dict:
+        return self._add('session', event=event,
+                         session_id=session_id)
+
+    # -- selectors --
+
+    def of_kind(self, kind: str) -> list[dict]:
+        return [r for r in self.records if r['kind'] == kind]
+
+    def member_timeline(self) -> list[dict]:
+        return self.of_kind('member')
+
+
+# ---------------------------------------------------------------------
+# Invariant checkers.  Each returns a list of violation strings.
+# ---------------------------------------------------------------------
+
+
+def check_acked_durability(history: History, db) -> list[str]:
+    """Invariant 1: no acked write lost.  ``db`` is the leader
+    ZKDatabase (reads bypass the wire; faults are stopped)."""
+    from ..server.store import ZKOpError
+
+    out: list[str] = []
+    # final acked action per created path, in history order; the
+    # ambiguity excuses are ORDERED — an acked op that postdates an
+    # ambiguous one proves that ambiguity resolved, so it spends the
+    # excuse
+    created: dict[str, dict] = {}
+    deleted: dict[str, dict] = {}
+    ambig_delete: set[str] = set()
+    ambig_create: set[str] = set()
+    last_set: dict[str, int] = {}
+    for r in history.records:
+        if r['kind'] == 'ack':
+            if r['op'] == 'create':
+                created[r['path']] = r
+                deleted.pop(r['path'], None)
+                ambig_delete.discard(r['path'])
+            elif r['op'] == 'delete':
+                deleted[r['path']] = r
+                created.pop(r['path'], None)
+                ambig_delete.discard(r['path'])
+                ambig_create.discard(r['path'])
+                # sets acked before this delete were deleted with the
+                # node; they say nothing about a later re-create
+                last_set.pop(r['path'], None)
+            elif r['op'] == 'set':
+                last_set[r['path']] = max(
+                    last_set.get(r['path'], -1), r['index'])
+        elif r['kind'] == 'ambig':
+            if r['op'] == 'delete':
+                ambig_delete.add(r['path'])
+            elif r['op'] == 'create' and r.get('path'):
+                ambig_create.add(r['path'])
+    for path, rec in created.items():
+        if path in deleted:
+            continue
+        try:
+            got, _stat = db.get_data(path)
+        except ZKOpError:
+            if path in ambig_delete:
+                continue            # an unacked delete may have landed
+            if rec.get('ephemeral'):
+                continue            # judged by check_ephemerals
+            out.append('acked create %s lost (NO_NODE after campaign)'
+                       % (path,))
+            continue
+        if path in last_set:
+            continue                # value judged by the set check
+        if rec['data'] is not None and bytes(got) != rec['data']:
+            out.append('acked create %s holds %r, expected %r'
+                       % (path, bytes(got), rec['data']))
+    for path in deleted:
+        try:
+            db.get_data(path)
+        except ZKOpError:
+            continue
+        if path in ambig_create:
+            continue            # an unacked re-create may have landed
+        out.append('acked delete %s did not stick' % (path,))
+    for path, idx in last_set.items():
+        if path in deleted:
+            continue
+        try:
+            got, _stat = db.get_data(path)
+            have = int(bytes(got).rsplit(b'v', 1)[1])
+        except (ZKOpError, ValueError, IndexError):
+            out.append('acked set v%d on %s lost: node unreadable'
+                       % (idx, path))
+            continue
+        if have < idx:
+            out.append('acked set v%d on %s lost: final value %r'
+                       % (idx, path, bytes(got)))
+    return out
+
+
+def check_zxid_monotonic(history: History) -> list[str]:
+    """Invariant 2: write-reply zxids never decrease per session."""
+    out: list[str] = []
+    last: dict[int, tuple[int, str]] = {}
+    for r in history.of_kind('op'):
+        if r['status'] != 'ok' or r['op'] not in WRITE_OPS:
+            continue
+        zxid = r.get('zxid')
+        sid = r.get('session_id') or 0
+        if zxid is None or not sid:
+            continue
+        prev = last.get(sid)
+        if prev is not None and zxid < prev[0]:
+            out.append(
+                'zxid regression on session %016x: %s %s replied '
+                'zxid %d after %s had replied %d'
+                % (sid, r['op'], r.get('path'), zxid, prev[1],
+                   prev[0]))
+        if prev is None or zxid >= prev[0]:
+            last[sid] = (zxid, '%s %s' % (r['op'], r.get('path')))
+    return out
+
+
+def check_ephemerals(history: History, db) -> list[str]:
+    """Invariant 3: ephemerals live exactly as long as their owning
+    session."""
+    out: list[str] = []
+    acked_del: set[str] = set()
+    ambig_del: set[str] = set()
+    ephemerals: list[dict] = []
+    for r in history.records:
+        if r['kind'] == 'ack' and r['op'] == 'create' \
+                and r.get('ephemeral'):
+            ephemerals.append(r)
+        elif r['kind'] == 'ack' and r['op'] == 'delete':
+            acked_del.add(r['path'])
+        elif r['kind'] == 'ambig' and r['op'] == 'delete':
+            ambig_del.add(r['path'])
+    for rec in ephemerals:
+        path, sid = rec['path'], rec['session_id']
+        sess = db.sessions.get(sid)
+        alive = (sess is not None and not sess.expired
+                 and not sess.closed)
+        exists = path in db.nodes
+        if not alive and exists:
+            out.append(
+                'ephemeral %s outlived its session %016x (confirmed '
+                '%s)' % (path, sid,
+                         'expired' if sess is None or sess.expired
+                         else 'closed'))
+        elif alive and not exists and path not in acked_del \
+                and path not in ambig_del:
+            out.append(
+                'ephemeral %s vanished while its session %016x is '
+                'still live' % (path, sid))
+        elif exists and db.nodes[path].ephemeral_owner != sid:
+            out.append(
+                'ephemeral %s owned by %016x, expected %016x'
+                % (path, db.nodes[path].ephemeral_owner, sid))
+    return out
+
+
+def _seq_number(path: str) -> int:
+    return int(path[-10:])
+
+
+def check_sequential(history: History) -> list[str]:
+    """Invariant 4: per parent, acked sequential numbers strictly
+    increase, and every gap is covered by an ambiguous create
+    *recorded before the ack that reveals the gap* — ops complete in
+    issue order, so an ambiguous create recorded later could only
+    have consumed a higher number and must not excuse an earlier
+    loss."""
+    out: list[str] = []
+    prev: dict[str, int] = {}        # parent -> last acked number
+    avail: dict[str, int] = {}       # parent -> unspent ambig creates
+    for r in history.records:
+        parent = r.get('seq_parent')
+        if parent is None:
+            continue
+        if r['kind'] == 'ambig' and r['op'] == 'create':
+            avail[parent] = avail.get(parent, 0) + 1
+        elif r['kind'] == 'ack' and r['op'] == 'create':
+            num = _seq_number(r['path'])
+            last = prev.get(parent)
+            if last is not None and num <= last:
+                out.append(
+                    'sequential numbering under %s not increasing: '
+                    '%d acked after %d' % (parent, num, last))
+                continue
+            gap = num - (last + 1 if last is not None else 0)
+            have = avail.get(parent, 0)
+            if gap > have:
+                out.append(
+                    'sequential gap under %s: number(s) %s missing '
+                    'before acked %d with only %d prior ambiguous '
+                    'create(s) to have consumed them'
+                    % (parent,
+                       list(range((last + 1 if last is not None
+                                   else 0), num)), num, have))
+            else:
+                avail[parent] = have - gap
+            prev[parent] = num
+    return out
+
+
+def check_watch_once(history: History) -> list[str]:
+    """Invariant 5: each watch delivers a given change at most once."""
+    out: list[str] = []
+    seen: dict[tuple[str, str], set[int]] = {}
+    deleted_fires: dict[str, int] = {}
+    for r in history.of_kind('watch'):
+        path, event, zxid = r['path'], r['event'], r.get('zxid')
+        if zxid is None:
+            if event == 'deleted':
+                deleted_fires[path] = deleted_fires.get(path, 0) + 1
+            continue
+        zset = seen.setdefault((path, event), set())
+        if zxid in zset:
+            out.append('duplicated %s watch fire for %s at zxid %d'
+                       % (event, path, zxid))
+        zset.add(zxid)
+    for path, n in deleted_fires.items():
+        if n > 1:
+            out.append('%d deleted fires for %s (deleted at most '
+                       'once)' % (n, path))
+    return out
+
+
+def check_history(history: History, db) -> list[str]:
+    """Run every invariant against the history and the leader's
+    final database; returns the combined violation list."""
+    out: list[str] = []
+    out.extend(check_acked_durability(history, db))
+    out.extend(check_zxid_monotonic(history))
+    out.extend(check_ephemerals(history, db))
+    out.extend(check_sequential(history))
+    out.extend(check_watch_once(history))
+    return out
+
+
+def format_history(history: 'History | list[dict]',
+                   kinds=('member', 'session'),
+                   limit: int | None = None) -> str:
+    """Render the member-event (and session-edge) timeline for a
+    failure report, oldest first.  Accepts a :class:`History` or a
+    plain record list (``ScheduleResult.history``)."""
+    records = history.records if isinstance(history, History) \
+        else history
+    rows = [r for r in records if r['kind'] in kinds]
+    if limit is not None and len(rows) > limit:
+        rows = rows[-limit:]
+    lines = []
+    for r in rows:
+        if r['kind'] == 'member':
+            lines.append('  t=%-4d member %-8s %s'
+                         % (r['t'], r['member'], r['event']))
+        else:
+            lines.append('  t=%-4d session %016x %s'
+                         % (r['t'], r['session_id'], r['event']))
+    return '\n'.join(lines)

@@ -412,6 +412,21 @@ class ConnectionPool(EventEmitter):
 
     # -- decoherence: move toward preferred backends --
 
+    def rebalance_now(self) -> None:
+        """Trigger one decoherence pass immediately instead of waiting
+        out the interval: if the pool currently serves a less-preferred
+        backend, dial the more-preferred ones and migrate the live
+        session on success (the session's 'reattaching' state reverts
+        on failure).  A no-op while already rebalancing, stopped, or
+        on the most-preferred backend.  The ensemble chaos campaign
+        uses this to force session migration mid-operation."""
+        if self._stopping:
+            return
+        if self._decoherence_task is None or \
+                self._decoherence_task.done():
+            self._decoherence_task = ambient_loop().create_task(
+                self._try_rebalance())
+
     def _arm_decoherence(self) -> None:
         self._cancel_decoherence()
         loop = ambient_loop()

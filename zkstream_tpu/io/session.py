@@ -90,6 +90,14 @@ class ZKSession(FSM):
         self.bind_fsm_metrics(self.collector, 'ZKSession')
         super().__init__('detached')
 
+    def _trace_edge(self, what: str, session_id: int) -> None:
+        """Record a session lifecycle edge into the shared span ring
+        (when one is attached), so a campaign's trace dump interleaves
+        session create/resume/expiry with ops and member events."""
+        if self.trace is not None:
+            self.trace.note(what, kind='session',
+                            session_id='%016x' % (session_id,))
+
     # -- public accessors --
 
     def is_attaching(self) -> bool:
@@ -230,6 +238,8 @@ class ZKSession(FSM):
                 sessionId='%016x' % (pkt['sessionId'],))
             self.log.info('%s zookeeper session with timeout %d ms',
                           verb, pkt['timeOut'])
+            self._trace_edge('SESSION_' + verb.upper(),
+                             pkt['sessionId'])
             self.timeout = pkt['timeOut']
             self.session_id = pkt['sessionId']
             self.passwd = pkt['passwd']
@@ -301,6 +311,7 @@ class ZKSession(FSM):
             self.log.info('moved zookeeper session to more preferred '
                           'backend (%s) with timeout %d ms',
                           self.conn.backend.key, pkt['timeOut'])
+            self._trace_edge('SESSION_MIGRATED', pkt['sessionId'])
             self.timeout = pkt['timeOut']
             self.session_id = pkt['sessionId']
             self.passwd = pkt['passwd']
@@ -358,6 +369,7 @@ class ZKSession(FSM):
         self.conn = None
         self._cancel_expiry_timer()
         self._cancel_rearm_retry()
+        self._trace_edge('SESSION_EXPIRED', self.session_id)
         self.log.warning('ZK session expired')
 
     def state_closed(self, S) -> None:

@@ -125,6 +125,13 @@ class ReplicationService:
         #: writes still land while the event stream starves — the
         #: piggyback/ack machinery is what must absorb the gap).
         self.faults = None
+        #: Deterministic partition windows, by follower token: while a
+        #: token is in this set, EVERY push to it drops (the
+        #: campaign-scheduled form of the asymmetric partition; the
+        #: injector's ``drop_push`` is the probabilistic form).  Heal
+        #: by discarding the token — recovery rides the control
+        #: channel's piggyback, same as the probabilistic path.
+        self.partitioned: set[str] = set()
 
     async def start(self) -> 'ReplicationService':
         self._server = await asyncio.start_server(
@@ -158,7 +165,16 @@ class ReplicationService:
     def _push(self, handle: _FollowerHandle, msg) -> None:
         if handle.writer is None:
             return
-        if self.faults is not None and \
+        # Only steady-state pushes partition: the attach/snapshot
+        # barrier is the join handshake — a partitioned joiner in real
+        # ZK fails its sync and retries from scratch, which here would
+        # just re-run connect(); dropping the handshake models nothing
+        # the refusal faults don't already, and would turn every
+        # campaign restart into a 10 s attach timeout.
+        droppable = msg[0] in ('commit', 'session_expired')
+        if droppable and handle.token in self.partitioned:
+            return                   # scheduled partition window
+        if droppable and self.faults is not None and \
                 self.faults.drop_push(handle.token):
             # Asymmetric partition: this push is lost.  For 'commit'
             # pushes the shipped cursor still advances in
@@ -349,6 +365,13 @@ class RemoteLeader(EventEmitter):
         #: kept referenced: a dropped StreamWriter closes its transport
         #: and the leader would see EOF and detach this follower
         self._events_writer: asyncio.StreamWriter | None = None
+
+    @property
+    def token(self) -> str:
+        """This follower's channel-pairing token — the key the
+        leader-side partition controls (``ReplicationService.
+        partitioned``, ``FaultInjector.drop_push``) select it by."""
+        return self._token
 
     # -- ReplicaStore's leader surface --
 

@@ -486,6 +486,16 @@ class ZKServer:
             await self._server.wait_closed()
             self._server = None
 
+    async def restart(self) -> 'ZKServer':
+        """Bring a killed member back on its old port; a rejoining
+        member first applies everything the leader committed while it
+        was down, like a real follower resync."""
+        assert self._server is None, 'server still running'
+        self.store.catch_up()
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port)
+        return self
+
     @property
     def address(self) -> tuple[str, int]:
         return (self.host, self.port)
@@ -602,11 +612,7 @@ class ZKEnsemble:
     async def restart(self, idx: int) -> None:
         """Bring a killed member back on its old port; a rejoining
         follower first syncs with the leader, like a real one."""
-        srv = self.servers[idx]
-        assert srv._server is None, 'server still running'
-        srv.store.catch_up()
-        srv._server = await asyncio.start_server(
-            srv._on_client, srv.host, srv.port)
+        await self.servers[idx].restart()
 
     def addresses(self) -> list[tuple[str, int]]:
         return [s.address for s in self.servers]

@@ -32,6 +32,7 @@ import asyncio
 import dataclasses
 import logging
 import secrets
+import threading
 import time
 
 from ..protocol.consts import CreateFlag
@@ -454,6 +455,15 @@ class ReplicaStore(NodeTree):
         #: apply; only ever advances, which is what lets the leader
         #: truncate the applied-everywhere prefix
         self.applied = 0
+        #: Serializes :meth:`_apply_until`: normally every apply runs
+        #: on the member's event loop, but the cross-process replica's
+        #: blocking control-channel RPCs are legitimately driven from
+        #: another thread (run_in_executor — the sync barrier in the
+        #: chaos campaign, test harnesses), and its piggyback triggers
+        #: catch_up on THAT thread while an events-channel push can
+        #: trigger it on the loop; an unguarded read-modify-write of
+        #: ``applied`` would skip or double-apply an entry.
+        self._apply_lock = threading.Lock()
         leader.attach_replica(self)
         leader.on('committed', self._on_commit)
 
@@ -469,11 +479,14 @@ class ReplicaStore(NodeTree):
     def _apply_until(self, target: int) -> None:
         """Apply log entries up to absolute index ``target``
         (idempotent: a timer firing after a ``catch_up`` already passed
-        it is a no-op, so application order is always log order)."""
+        it is a no-op, so application order is always log order; the
+        lock keeps that true when an off-loop control-channel thread
+        races an on-loop events push — see ``_apply_lock``)."""
         ldr = self.leader
-        while self.applied < min(target, ldr.log_end()):
-            self._apply_one(ldr.log[self.applied - ldr.log_base])
-            self.applied += 1
+        with self._apply_lock:
+            while self.applied < min(target, ldr.log_end()):
+                self._apply_one(ldr.log[self.applied - ldr.log_base])
+                self.applied += 1
 
     def _apply_one(self, entry: tuple) -> None:
         op = entry[0]

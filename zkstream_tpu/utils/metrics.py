@@ -169,6 +169,36 @@ class Histogram:
         row = self._series.get(_label_key(labels))
         return row[-1] if row is not None else 0.0
 
+    def label_keys(self) -> list[tuple[tuple[str, str], ...]]:
+        """Every label set this histogram holds series for (sorted
+        key tuples, as ``_label_key`` produces)."""
+        return list(self._series)
+
+    def percentile(self, q: float,
+                   labels: dict[str, str] | None = None) -> float:
+        """Estimate the ``q``-th percentile (0..100) the way
+        ``histogram_quantile`` does: find the bucket the rank falls
+        in, interpolate linearly inside it.  The +Inf bucket clamps
+        to the largest finite bound (no upper edge to interpolate
+        toward); an empty series returns NaN."""
+        row = self._series.get(_label_key(labels))
+        if row is None:
+            return float('nan')
+        total = sum(row[:-1])
+        if total == 0:
+            return float('nan')
+        rank = q / 100.0 * total
+        cum = 0.0
+        lo = 0.0
+        for i, bound in enumerate(self.buckets):
+            prev = cum
+            cum += row[i]
+            if cum >= rank:
+                frac = (rank - prev) / row[i] if row[i] else 0.0
+                return lo + (bound - lo) * frac
+            lo = bound
+        return self.buckets[-1]
+
     def bucket_value(self, le: float,
                      labels: dict[str, str] | None = None) -> int:
         """Cumulative count for the bucket with upper bound ``le``
