@@ -24,6 +24,7 @@ from typing import Callable
 from ..protocol import consts
 from ..protocol.errors import ZKError, ZKPingTimeoutError, ZKProtocolError
 from ..protocol.framing import PacketCodec
+from ..utils.aio import set_nodelay
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
@@ -87,6 +88,7 @@ class _SocketProtocol(asyncio.Protocol):
         self._conn = conn
 
     def connection_made(self, transport) -> None:
+        set_nodelay(transport)
         self._conn.transport = transport
         self._conn.emit('sockConnect')
 

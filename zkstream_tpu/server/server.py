@@ -26,6 +26,7 @@ import logging
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
 from ..protocol.framing import PacketCodec
+from ..utils.aio import set_nodelay
 from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 
 log = logging.getLogger('zkstream_tpu.server')
@@ -468,6 +469,7 @@ class ZKServer:
             except (ConnectionError, RuntimeError):
                 pass
             return
+        set_nodelay(writer)
         conn = ServerConnection(self, reader, writer)
         self.conns.add(conn)
         await conn.run()
