@@ -1,0 +1,222 @@
+"""Wire equivalence for the single-pass encode tier
+(protocol/fastencode.py).
+
+The JuteWriter walk in protocol/records.py is the semantic spec; the
+FastEncoder must either produce byte-identical frames or decline
+(return None) so the codec falls back.  When the C extension is
+buildable its encoders are held to the same corpus (three tiers, one
+wire)."""
+
+from __future__ import annotations
+
+import pytest
+
+from zkstream_tpu.protocol import records
+from zkstream_tpu.protocol.fastencode import FastEncoder
+from zkstream_tpu.protocol.framing import PacketCodec, frame
+from zkstream_tpu.protocol.jute import JuteValueError, JuteWriter
+from zkstream_tpu.utils import native
+
+STAT = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, 3, 2, 8)
+STAT_EXTREME = records.Stat(
+    -(1 << 63), (1 << 63) - 1, 0, -1,
+    -(1 << 31), (1 << 31) - 1, 0, (1 << 62), -5, 0, 7)
+CUSTOM_ACL = [
+    records.ACL(records.Perm.READ | records.Perm.WRITE,
+                records.Id('digest', 'user:hash')),
+    records.ACL(records.Perm.ALL, records.Id('', '')),
+]
+
+REQUESTS = [
+    {'xid': 1, 'opcode': 'GET_DATA', 'path': '/a/b', 'watch': True},
+    {'xid': 2, 'opcode': 'GET_DATA', 'path': '', 'watch': False},
+    {'xid': 3, 'opcode': 'EXISTS', 'path': '/λ/ü',
+     'watch': True},
+    {'xid': 4, 'opcode': 'GET_CHILDREN', 'path': '/', 'watch': False},
+    {'xid': 5, 'opcode': 'GET_CHILDREN2', 'path': '/x', 'watch': True},
+    {'xid': 6, 'opcode': 'CREATE', 'path': '/n', 'data': b'payload',
+     'acl': records.OPEN_ACL_UNSAFE, 'flags': 0},
+    {'xid': 7, 'opcode': 'CREATE', 'path': '/n', 'data': b'',
+     'acl': records.OPEN_ACL_UNSAFE, 'flags': 3},
+    {'xid': 8, 'opcode': 'CREATE', 'path': '/n', 'data': b'x' * 300,
+     'acl': list(records.OPEN_ACL_UNSAFE), 'flags': 1},
+    {'xid': 9, 'opcode': 'CREATE', 'path': '/n', 'data': b'd',
+     'acl': CUSTOM_ACL},
+    {'xid': 10, 'opcode': 'DELETE', 'path': '/n', 'version': -1},
+    {'xid': 11, 'opcode': 'DELETE', 'path': '/n',
+     'version': (1 << 31) - 1},
+    {'xid': 12, 'opcode': 'GET_ACL', 'path': '/n'},
+    {'xid': 13, 'opcode': 'SYNC', 'path': '/n'},
+    {'xid': 14, 'opcode': 'SET_DATA', 'path': '/n', 'data': b'v',
+     'version': 5},
+    {'xid': 15, 'opcode': 'SET_DATA', 'path': '/n', 'data': b'',
+     'version': -1},
+    {'xid': -2, 'opcode': 'PING'},
+    {'xid': 16, 'opcode': 'CLOSE_SESSION'},
+]
+
+REPLIES = [
+    {'xid': 1, 'zxid': 100, 'opcode': 'GET_DATA', 'err': 'OK',
+     'data': b'abc', 'stat': STAT},
+    {'xid': 2, 'zxid': -1, 'opcode': 'GET_DATA', 'err': 'OK',
+     'data': b'', 'stat': STAT_EXTREME},
+    {'xid': 3, 'zxid': 101, 'opcode': 'EXISTS', 'err': 'OK',
+     'stat': STAT},
+    {'xid': 4, 'zxid': 102, 'opcode': 'SET_DATA', 'err': 'OK',
+     'stat': STAT_EXTREME},
+    {'xid': 5, 'zxid': 103, 'opcode': 'CREATE', 'err': 'OK',
+     'path': '/a/b0000000001'},
+    {'xid': 6, 'zxid': 104, 'opcode': 'CREATE', 'err': 'OK',
+     'path': ''},
+    {'xid': 7, 'zxid': 105, 'opcode': 'GET_CHILDREN2', 'err': 'OK',
+     'children': ['x', 'y'], 'stat': STAT},
+    {'xid': 8, 'zxid': 106, 'opcode': 'GET_CHILDREN', 'err': 'OK',
+     'children': []},
+    {'xid': 9, 'zxid': 107, 'opcode': 'GET_CHILDREN', 'err': 'OK',
+     'children': ['', 'a', 'é']},
+    {'xid': 10, 'zxid': 108, 'opcode': 'GET_ACL', 'err': 'OK',
+     'acl': list(records.OPEN_ACL_UNSAFE), 'stat': STAT},
+    {'xid': 11, 'zxid': 109, 'opcode': 'GET_ACL', 'err': 'OK',
+     'acl': CUSTOM_ACL, 'stat': STAT},
+    {'xid': 12, 'zxid': 110, 'opcode': 'DELETE', 'err': 'OK'},
+    {'xid': 13, 'zxid': 111, 'opcode': 'GET_DATA', 'err': 'NO_NODE'},
+    {'xid': 14, 'zxid': 112, 'opcode': 'CREATE', 'err': 'NODE_EXISTS'},
+    {'xid': -1, 'zxid': 113, 'opcode': 'NOTIFICATION', 'err': 'OK',
+     'type': 'DATA_CHANGED', 'state': 'SYNC_CONNECTED', 'path': '/a'},
+    {'xid': -1, 'zxid': 114, 'opcode': 'NOTIFICATION', 'err': 'OK',
+     'type': 'DELETED', 'state': 'EXPIRED', 'path': ''},
+    {'xid': -2, 'zxid': 115, 'opcode': 'PING', 'err': 'OK'},
+    {'xid': 15, 'zxid': 116, 'opcode': 'SYNC', 'err': 'OK'},
+    {'xid': 16, 'zxid': 117, 'opcode': 'SET_WATCHES', 'err': 'OK'},
+    {'xid': 17, 'zxid': 118, 'opcode': 'CLOSE_SESSION', 'err': 'OK'},
+]
+
+
+def spec_request(pkt: dict) -> bytes:
+    w = JuteWriter()
+    records.write_request(w, dict(pkt))
+    return frame(w.to_bytes())
+
+
+def spec_response(pkt: dict) -> bytes:
+    w = JuteWriter()
+    records.write_response(w, dict(pkt))
+    return frame(w.to_bytes())
+
+
+@pytest.mark.parametrize('pkt', REQUESTS,
+                         ids=lambda p: '%s-%s' % (p['opcode'], p['xid']))
+def test_request_equivalence(pkt):
+    enc = FastEncoder()
+    got = enc.encode_request(dict(pkt))
+    assert got is not None, 'fast tier must cover steady-state requests'
+    assert got == spec_request(pkt)
+
+
+@pytest.mark.parametrize('pkt', REPLIES,
+                         ids=lambda p: '%s-%s-%s' % (
+                             p['opcode'], p['err'], p['xid']))
+def test_response_equivalence(pkt):
+    enc = FastEncoder()
+    got = enc.encode_response(dict(pkt))
+    assert got is not None, 'fast tier must cover steady-state replies'
+    assert got == spec_response(pkt)
+
+
+def test_scratch_reuse_no_residue():
+    """A big frame must not leak residue into a later small one
+    (the scratch buffer is reused across encodes)."""
+    enc = FastEncoder()
+    big = {'xid': 1, 'zxid': 1, 'opcode': 'GET_DATA', 'err': 'OK',
+           'data': b'\xff' * 4096, 'stat': STAT}
+    small = {'xid': 2, 'zxid': 2, 'opcode': 'EXISTS', 'err': 'OK',
+             'stat': STAT}
+    assert enc.encode_response(dict(big)) == spec_response(big)
+    assert enc.encode_response(dict(small)) == spec_response(small)
+    assert enc.encode_response(dict(big)) == spec_response(big)
+
+
+def test_uncovered_shapes_fall_back():
+    enc = FastEncoder()
+    # SET_WATCHES stays on the spec path (resume-time-rare)
+    assert enc.encode_request({'xid': -8, 'opcode': 'SET_WATCHES',
+                               'relZxid': 0, 'events': {}}) is None
+    # non-bool watch: the spec raises its own JuteValueError
+    assert enc.encode_request({'xid': 1, 'opcode': 'GET_DATA',
+                               'path': '/a', 'watch': 1}) is None
+    # out-of-range flags: CreateFlag normalization is spec business
+    assert enc.encode_request(
+        {'xid': 1, 'opcode': 'CREATE', 'path': '/a', 'data': b'',
+         'acl': records.OPEN_ACL_UNSAFE, 'flags': -1}) is None
+    # out-of-range xid: spec raises JuteValueError
+    assert enc.encode_request({'xid': 1 << 40, 'opcode': 'PING'}) is None
+    # malformed stat: spec raises
+    assert enc.encode_response({'xid': 1, 'zxid': 1, 'opcode': 'EXISTS',
+                                'err': 'OK', 'stat': (1, 2, 3)}) is None
+    # unknown err name: spec raises KeyError
+    assert enc.encode_response({'xid': 1, 'zxid': 1, 'opcode': 'EXISTS',
+                                'err': 'NOT_A_CODE',
+                                'stat': STAT}) is None
+
+
+def test_codec_tiering_matches_spec(monkeypatch):
+    """PacketCodec with the fast tier engaged produces the same bytes
+    as with it disabled (ZKSTREAM_NO_FASTENC=1), for both directions,
+    and the same validation errors on bad packets."""
+    fast_c = PacketCodec(use_native=False)
+    fast_s = PacketCodec(server=True, use_native=False)
+    fast_c.handshaking = fast_s.handshaking = False
+    monkeypatch.setenv('ZKSTREAM_NO_FASTENC', '1')
+    spec_c = PacketCodec(use_native=False)
+    spec_s = PacketCodec(server=True, use_native=False)
+    spec_c.handshaking = spec_s.handshaking = False
+    assert fast_c._fast is not None and spec_c._fast is None
+    for pkt in REQUESTS:
+        assert fast_c.encode(dict(pkt)) == spec_c.encode(dict(pkt)), pkt
+    assert fast_c.xid_map == spec_c.xid_map
+    for pkt in REPLIES:
+        assert fast_s.encode(dict(pkt)) == spec_s.encode(dict(pkt)), pkt
+    with pytest.raises(JuteValueError):
+        fast_c.encode({'xid': 1 << 40, 'opcode': 'PING'})
+
+
+def test_roundtrip_through_decoder():
+    """Frames from the fast tier decode back to the packets that made
+    them (closing the loop with the receive side)."""
+    enc = PacketCodec(server=True, use_native=False)
+    enc.handshaking = False
+    wire = b''.join(enc.encode(dict(p)) for p in REPLIES)
+    dec = PacketCodec(use_native=False)
+    dec.handshaking = False
+    dec.xid_map = {p['xid']: p['opcode'] for p in REPLIES
+                   if p['xid'] > 0}
+    pkts = dec.decode(wire)
+    assert len(pkts) == len(REPLIES)
+    for got, want in zip(pkts, REPLIES):
+        assert got['opcode'] == want['opcode']
+        assert got['err'] == want['err']
+        if want['err'] == 'OK' and 'stat' in want:
+            assert got.get('stat') == want['stat']
+        if 'data' in want:
+            assert got['data'] == want['data']
+
+
+@pytest.mark.skipif(native.ensure_ext() is None,
+                    reason='native extension unavailable')
+def test_three_tiers_agree():
+    """C extension, fast Python, and the JuteWriter spec produce one
+    wire, wherever the faster tiers accept the shape."""
+    ext = native.ensure_ext()
+    enc = FastEncoder()
+    for pkt in REQUESTS:
+        want = spec_request(pkt)
+        cw = ext.encode_request(dict(pkt))
+        if cw is not None:
+            assert cw == want, pkt
+        assert enc.encode_request(dict(pkt)) == want, pkt
+    for pkt in REPLIES:
+        want = spec_response(pkt)
+        cw = ext.encode_response(dict(pkt))
+        if cw is not None:
+            assert cw == want, pkt
+        assert enc.encode_response(dict(pkt)) == want, pkt

@@ -17,11 +17,13 @@ in-process ZooKeeper *server* for tests
 
 from __future__ import annotations
 
+import os
 import struct
 
 from . import records
 from .consts import MAX_PACKET
 from .errors import ZKProtocolError
+from .fastencode import FastEncoder
 from .jute import JuteReader, JuteWriter
 
 _LEN = struct.Struct('>i')
@@ -143,6 +145,12 @@ class PacketCodec:
             from ..utils import native
             self._ext = (native.ensure_ext() if use_native
                          else native.get_ext())
+        # Middle encode tier: single-pass struct-batched Python
+        # (protocol/fastencode.py).  Runs when the C encoder is absent
+        # or declines a shape; the JuteWriter walk below stays the
+        # spec and the last resort.
+        self._fast = (None if os.environ.get('ZKSTREAM_NO_FASTENC')
+                      == '1' else FastEncoder())
 
     @property
     def ext(self):
@@ -160,6 +168,15 @@ class PacketCodec:
             # validation errors; byte equality is A/B-tested.
             data = (self._ext.encode_response(pkt) if self._server
                     else self._ext.encode_request(pkt))
+            if data is not None:
+                if not self._server:
+                    self.xid_map[pkt['xid']] = pkt['opcode']
+                return data
+        if self._fast is not None and not self.handshaking:
+            # single-pass Python tier: same None-means-fall-back
+            # contract as the C encoder, same A/B-tested equivalence
+            data = (self._fast.encode_response(pkt) if self._server
+                    else self._fast.encode_request(pkt))
             if data is not None:
                 if not self._server:
                     self.xid_map[pkt['xid']] = pkt['opcode']
