@@ -88,6 +88,19 @@ def test_draws_consumed_even_when_fault_disabled():
 
 
 # -- the 200-schedule randomized campaign ------------------------------
+#
+# The campaign runs with the outbound send plane in its default state:
+# tick-corked write coalescing ENABLED on both the client and the
+# in-process server (io/sendplane.py) — asserted below so a stray
+# ZKSTREAM_NO_CORK in the test environment cannot silently weaken what
+# these schedules exercise.  The cork-disabled slice lives in
+# tests/test_sendplane.py.
+
+def test_campaign_runs_with_coalescing_enabled():
+    from zkstream_tpu.io.sendplane import cork_default
+    assert cork_default(), \
+        'ZKSTREAM_NO_CORK must not be set for the tier-1 campaign'
+
 
 @pytest.mark.timeout(240)
 @pytest.mark.parametrize('batch', range(BATCHES))

@@ -271,6 +271,19 @@ def _assert_clean_scrape(collector: Collector, result) -> None:
         assert 'zookeeper_degraded 0.0' in text, \
             'seed %d ended degraded despite a clean schedule' \
             % (result.seed,)
+        # the outbound plane was engaged: a clean schedule's frames
+        # all flowed through the tick-cork (io/sendplane.py), which is
+        # the campaign default — so ensemble chaos genuinely exercises
+        # coalescing, not a silently-disabled plane
+        from zkstream_tpu.io.sendplane import (
+            METRIC_FLUSH_FRAMES,
+            cork_default,
+        )
+        if cork_default():
+            flushes = collector.get_collector(METRIC_FLUSH_FRAMES)
+            assert flushes.count({'plane': 'client'}) > 0, \
+                'seed %d: no client-plane flush recorded' \
+                % (result.seed,)
 
 
 def _campaign_failure_report(bad) -> str:

@@ -81,7 +81,8 @@ class Client(FSM):
                  op_timeout: int | None = DEFAULT_OP_TIMEOUT,
                  faults=None,
                  trace: TraceRing | None = None,
-                 trace_capacity: int = 256):
+                 trace_capacity: int = 256,
+                 cork: bool | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -112,6 +113,11 @@ class Client(FSM):
         #: None = auto (native if built), True = force C++, False =
         #: force pure Python (benchmarks, A/B tests).
         self.use_native_codec = use_native_codec
+        #: Outbound write coalescing for this client's connections
+        #: (io/sendplane.py): None = process default (on unless
+        #: ZKSTREAM_NO_CORK=1), True/False force a path (benchmarks,
+        #: A/B tests).
+        self.cork = cork
         #: Optional crash-on-bug policy override: called with the
         #: exception after session teardown instead of the loud default
         #: (loop exception handler).  See ZKSession.fatal_error.

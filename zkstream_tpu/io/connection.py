@@ -28,6 +28,7 @@ from ..utils.aio import set_nodelay
 from ..utils.events import EventEmitter
 from ..utils.fsm import FSM
 from ..utils.logging import Logger
+from .sendplane import SendPlane
 
 METRIC_ZK_CONNECT_LATENCY = 'zookeeper_connect_latency_ms'
 
@@ -146,7 +147,14 @@ class ZKConnection(FSM):
         #: 'connecting' (or on promote for a parked spare), observed
         #: into the histogram on reaching 'connected'.
         self._connect_t0: float | None = None
+        #: Outbound cork (io/sendplane.py): every encoded frame goes
+        #: through it; frames of one event-loop tick leave as a single
+        #: transport.write.  ``client.cork`` forces it on/off (None =
+        #: process default, see sendplane.cork_default).
         collector = getattr(client, 'collector', None)
+        self._tx = SendPlane(self._tx_write,
+                             enabled=getattr(client, 'cork', None),
+                             collector=collector, plane='client')
         self._connect_latency = None
         if collector is not None:
             self._connect_latency = collector.histogram(
@@ -423,6 +431,8 @@ class ZKConnection(FSM):
             self.log.info('sent CLOSE_SESSION request (xid %d)',
                           close_xid[0])
             self._write({'opcode': 'CLOSE_SESSION', 'xid': close_xid[0]})
+            # the EOF must not cut ahead of the corked CLOSE_SESSION
+            self._tx.flush_now()
             try:
                 if self.transport and self.transport.can_write_eof():
                     self.transport.write_eof()
@@ -497,6 +507,8 @@ class ZKConnection(FSM):
             except (OSError, RuntimeError):
                 pass
         self.transport = None
+        # corked frames have nowhere to go once the socket is dead
+        self._tx.reset()
 
         S.on(self, 'connectAsserted', lambda: S.goto_state('connecting'))
 
@@ -521,15 +533,30 @@ class ZKConnection(FSM):
         else:
             self.faults.rx(self, data)
 
+    def _tx_write(self, data: bytes) -> None:
+        """The send plane's sink: one coalesced buffer per flush."""
+        if self.transport is not None:
+            self.transport.write(data)
+
     def _write(self, pkt: dict) -> None:
         data = self.codec.encode(pkt)
         if self.faults is not None:
-            # may truncate the frame and schedule an injected reset
-            data = self.faults.tx(self, data)
-            if data is None:
+            # Per-frame fault boundary, BEFORE the cork: may truncate
+            # the frame and schedule an injected reset.
+            out = self.faults.tx(self, data)
+            if out is None:
                 return
-        if self.transport is not None:
-            self.transport.write(data)
+            if out is not data:
+                # A fault fired on this frame.  Its scheduled reset
+                # lands next tick — deliver everything already corked
+                # plus the truncated frame NOW, in stream order, so
+                # the reset still targets exactly this frame.
+                self._tx.flush_now()
+                self._tx_write(out)
+                return
+        if self.transport is None:
+            return
+        self._tx.send(data)
 
     def process_reply(self, pkt: dict) -> None:
         """Route a reply to its pending request

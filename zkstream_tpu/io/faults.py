@@ -349,9 +349,16 @@ class FaultInjector:
         return self._take('accept', self.config.p_accept_refuse,
                           'refuse accepted client')
 
-    def server_tx(self, server_conn, data: bytes) -> bool:
+    def server_tx(self, server_conn, data: bytes, pre=None) -> bool:
         """Server-side write hook.  Returns True when the injector took
-        over delivery (split/delay/reset), False for pass-through."""
+        over delivery (split/delay/reset), False for pass-through.
+
+        ``pre`` (the connection's send-plane ``flush_now``) runs before
+        the injector's first delivery whenever it takes over: frames
+        corked in earlier (un-faulted) writes must hit the wire first
+        or the stream would reorder in a way TCP never does.  The hook
+        itself stays a per-frame boundary — injection happens before
+        the cork, and a faulted frame bypasses it."""
         cfg = self.config
         wants_reset = self._take('server_tx', cfg.p_server_tx_reset,
                                  'server tx mid-frame reset')
@@ -364,8 +371,12 @@ class FaultInjector:
             # A delayed segment from an earlier write is still in the
             # gate: this (un-faulted) write must queue behind it, or
             # the stream would reorder in a way TCP never does.
+            if pre is not None:
+                pre()
             gate.push(data)
             return True
+        if pre is not None:
+            pre()
         if gate is None or gate.dead:
             def sink(d, c=server_conn):
                 if not c.closed:
@@ -550,6 +561,7 @@ async def run_schedule(seed: int, ops: int = 6,
 
     created: dict[str, bytes] = {}     # acked creates, path -> data
     deleted: set[str] = set()          # acked deletes
+    ambig_deleted: set[str] = set()    # deletes with unknown outcome
     last_acked_set = -1                # newest acked /w value index
     fires: list[int] = []              # dataChanged mzxids
 
@@ -608,8 +620,13 @@ async def run_schedule(seed: int, ops: int = 6,
                 if not live:
                     continue
                 path = inj.choice('plan', live)
-                ok, _ = await bounded(client.delete(path, -1),
-                                      'delete %s' % path)
+                # ambiguity-aware, like the ensemble tier: a delete
+                # failing with CONNECTION_LOSS etc. may still have
+                # applied, which must excuse the acked create's
+                # absence below (not count as acked-write loss)
+                ok, _ = await _bounded_op(
+                    res, client.delete(path, -1), 'delete %s' % path,
+                    on_ambiguous=lambda p=path: ambig_deleted.add(p))
                 if ok:
                     res.acked += 1
                     deleted.add(path)
@@ -631,6 +648,8 @@ async def run_schedule(seed: int, ops: int = 6,
             try:
                 got, _stat = db.get_data(path)
             except ZKOpError:
+                if path in ambig_deleted:
+                    continue    # an unacked delete may have landed
                 res.violations.append(
                     'acked create %s lost (NO_NODE after campaign)'
                     % (path,))

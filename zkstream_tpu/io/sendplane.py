@@ -1,0 +1,171 @@
+"""Tick-corked outbound write coalescing — the send-side twin of the
+batched ingest drain.
+
+Without it, every client op and every server reply is its own
+``transport.write`` — one syscall per frame, the per-message overhead
+the RPC-batching literature (PAPERS.md: RPCAcc, the transparent
+InfiniBand transports) amortizes at the transport boundary.  A
+``SendPlane`` sits between a connection's encoder and its transport:
+frames appended during one event-loop iteration are joined and written
+as a single buffer when the loop's ready-callback batch drains (one
+``call_soon``-scheduled flush per busy tick), with a size-capped early
+flush so a large burst cannot balloon the cork.  ``TCP_NODELAY`` is
+set on both ends (utils/aio.set_nodelay) so batching is this explicit
+per-tick cork, not the kernel's implicit per-RTT one.
+
+Ordering contract: every byte a connection sends goes through its
+plane in call order — either corked (``send``) or after an explicit
+``flush_now`` for paths that must hit the wire mid-tick (fault
+injection delivering a truncated frame before its scheduled reset,
+CLOSE_SESSION ahead of ``write_eof``, a server connection closing).
+The fault injector's tx hooks stay a per-frame boundary: injection
+happens *before* the cork, and an injected delivery pre-flushes the
+plane so the faulted frame cannot reorder ahead of earlier corked
+frames.
+
+Observability: per-flush batch size lands in the
+``zookeeper_flush_batch_frames`` / ``zookeeper_flush_batch_bytes``
+histograms (labelled ``plane="client"|"server"``), scraped by bench.py
+write-heavy cells and tools/sweep_crossover.py.
+
+``ZKSTREAM_NO_CORK=1`` (or ``cork=False`` on Client / ZKServer)
+degrades to write-through — every frame still flows through the plane
+(and the histograms), it just flushes per frame.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.aio import ambient_loop
+
+METRIC_FLUSH_FRAMES = 'zookeeper_flush_batch_frames'
+METRIC_FLUSH_BYTES = 'zookeeper_flush_batch_bytes'
+
+#: Frames-per-flush distribution buckets (a flush of 1 = no batching
+#: happened this tick; the interesting mass is 2+).
+FRAME_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+#: Bytes-per-flush distribution buckets.
+BYTE_BUCKETS = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+#: Early-flush cap: a burst larger than this flushes immediately
+#: instead of waiting for the tick boundary (bounds cork memory and
+#: keeps huge writes streaming).
+DEFAULT_MAX_CORK = 256 * 1024
+
+
+def cork_default() -> bool:
+    """Process-wide default for new planes (env kill switch)."""
+    return os.environ.get('ZKSTREAM_NO_CORK') != '1'
+
+
+class SendPlane:
+    """One connection's outbound cork.
+
+    ``write`` is the underlying sink (``transport.write`` behind a
+    liveness guard); it is only ever called with already-encoded,
+    already-fault-screened frame bytes, joined in append order.
+    """
+
+    __slots__ = ('_write', '_chunks', '_pending', '_scheduled',
+                 'enabled', 'max_bytes', '_frames_hist', '_bytes_hist',
+                 '_labels')
+
+    def __init__(self, write, *, enabled: bool | None = None,
+                 max_bytes: int = DEFAULT_MAX_CORK,
+                 collector=None, plane: str = 'client'):
+        self._write = write
+        self._chunks: list[bytes] = []
+        self._pending = 0
+        self._scheduled = False
+        self.enabled = cork_default() if enabled is None else enabled
+        self.max_bytes = max_bytes
+        self._frames_hist = None
+        self._bytes_hist = None
+        self._labels = {'plane': plane}
+        if collector is not None:
+            self._frames_hist = collector.histogram(
+                METRIC_FLUSH_FRAMES,
+                'Frames per coalesced transport write, by plane',
+                buckets=FRAME_BUCKETS)
+            self._bytes_hist = collector.histogram(
+                METRIC_FLUSH_BYTES,
+                'Bytes per coalesced transport write, by plane',
+                buckets=BYTE_BUCKETS)
+
+    @property
+    def pending(self) -> int:
+        """Bytes appended but not yet flushed."""
+        return self._pending
+
+    def send(self, data: bytes) -> None:
+        """Append one encoded frame; it reaches the sink at the next
+        tick flush (or immediately: cork disabled / size cap hit)."""
+        if not self.enabled:
+            self._observe(1, len(data))
+            self._write(data)
+            return
+        self._chunks.append(data)
+        self._pending += len(data)
+        if self._pending >= self.max_bytes:
+            self.flush_now()
+            return
+        if not self._scheduled:
+            self._scheduled = True
+            ambient_loop().call_soon(self._tick_flush)
+
+    def _tick_flush(self) -> None:
+        self._scheduled = False
+        self.flush_now()
+
+    def flush_now(self) -> None:
+        """Write everything corked, in order, as one buffer.  Safe to
+        call any time (idle flush is a no-op); paths that must hit the
+        wire mid-tick (fault delivery, EOF, close) call this first so
+        the stream cannot reorder."""
+        if not self._chunks:
+            return
+        chunks = self._chunks
+        n = len(chunks)
+        size = self._pending
+        self._chunks = []
+        self._pending = 0
+        self._observe(n, size)
+        self._write(chunks[0] if n == 1 else b''.join(chunks))
+
+    def reset(self) -> None:
+        """Drop corked frames without writing (connection aborted:
+        the bytes have nowhere to go)."""
+        self._chunks = []
+        self._pending = 0
+
+    def _observe(self, frames: int, nbytes: int) -> None:
+        if self._frames_hist is not None:
+            self._frames_hist.observe(frames, self._labels)
+            self._bytes_hist.observe(nbytes, self._labels)
+
+
+def scrape_flush_cells(collector) -> dict:
+    """Summarize the flush-batch histograms per plane for bench cells
+    (bench.py client_ops, tools/sweep_crossover.py): flush count,
+    mean/p50/p99 frames per flush, p50/p99 bytes per flush."""
+    out: dict = {}
+    try:
+        fr = collector.get_collector(METRIC_FLUSH_FRAMES)
+        by = collector.get_collector(METRIC_FLUSH_BYTES)
+    except ValueError:
+        return out
+    for key in fr.label_keys():
+        labels = dict(key)
+        n = fr.count(labels)
+        if not n:
+            continue
+        out[labels.get('plane', '')] = {
+            'flushes': n,
+            'frames_mean': round(fr.sum(labels) / n, 2),
+            'frames_p50': round(fr.percentile(50, labels), 2),
+            'frames_p99': round(fr.percentile(99, labels), 2),
+            'bytes_p50': round(by.percentile(50, labels), 1),
+            'bytes_p99': round(by.percentile(99, labels), 1),
+        }
+    return out

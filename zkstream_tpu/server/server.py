@@ -25,6 +25,7 @@ import logging
 
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
+from ..io.sendplane import SendPlane
 from ..protocol.framing import PacketCodec
 from ..utils.aio import set_nodelay
 from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
@@ -62,19 +63,28 @@ class ServerConnection:
         #: connection's fate exactly once.
         self._admin_buf = b''
         self._admin_checked = False
+        #: Outbound cork (io/sendplane.py): replies and notifications
+        #: of one event-loop tick leave as a single writer.write (a
+        #: pipelined request batch is answered with one segment).
+        self._tx = SendPlane(self._tx_write, enabled=server.cork,
+                             collector=server.collector, plane='server')
 
     # -- wire helpers --
+
+    def _tx_write(self, data: bytes) -> None:
+        try:
+            self.writer.write(data)
+        except (ConnectionError, RuntimeError):
+            pass
 
     def _write_bytes(self, data: bytes) -> None:
         if self.closed:
             return
         fi = self.server.faults
-        if fi is not None and fi.server_tx(self, data):
+        if fi is not None and fi.server_tx(self, data,
+                                           pre=self._tx.flush_now):
             return   # the injector took over delivery (split/delay/RST)
-        try:
-            self.writer.write(data)
-        except (ConnectionError, RuntimeError):
-            pass
+        self._tx.send(data)
 
     def _send(self, pkt: dict) -> None:
         if self.closed:
@@ -238,6 +248,8 @@ class ServerConnection:
     def close(self) -> None:
         if self.closed:
             return
+        # corked replies (e.g. the CLOSE_SESSION ack) must beat the FIN
+        self._tx.flush_now()
         self.closed = True
         self._unsubscribe()
         if self.session is not None and self.session.owner is self:
@@ -420,11 +432,18 @@ class ZKServer:
 
     def __init__(self, db: ZKDatabase | None = None,
                  host: str = '127.0.0.1', port: int = 0,
-                 store=None):
+                 store=None, cork: bool | None = None,
+                 collector=None):
         self.db = db if db is not None else ZKDatabase()
         self.store = store if store is not None else self.db
         self.host = host
         self.port = port
+        #: Outbound write coalescing for accepted connections
+        #: (io/sendplane.py): None = process default, True/False force.
+        self.cork = cork
+        #: Optional utils/metrics.Collector: when set, accepted
+        #: connections record their flush-batch-size histograms here.
+        self.collector = collector
         self._server: asyncio.base_events.Server | None = None
         self.conns: set[ServerConnection] = set()
         #: Fault-injection knobs for tests: swallow pings (forces the
