@@ -59,6 +59,15 @@ asan:
 bench:
 	$(PYTHON) bench.py
 
+# Write-heavy (SET_DATA/CREATE-dominated) client-ops cells only: the
+# outbound-plane family (single-pass encode + tick-corked coalescing,
+# PROFILE.md "Encode side").  Host-path; prints per-cell flush-batch
+# distributions from zookeeper_flush_batch_frames/_bytes.  The paired
+# coalescing sign-test lives in tools/sweep_crossover.py
+# (--workload write --paired native,native-nocork).
+bench-write:
+	$(PYTHON) bench.py --write
+
 # Hunt a healthy window on a flaky accelerator tunnel, then run the
 # full TPU validation workload in it: the bench plus both pallas
 # sweeps (header rows and the fused full-decode confirmation rows).

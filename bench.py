@@ -732,17 +732,22 @@ def _percentiles(lat_ms):
     return pct(50), pct(99)
 
 
-async def _client_ops_run(mode: str, n_clients: int) -> dict:
+async def _client_ops_run(mode: str, n_clients: int,
+                          write_heavy: bool = False) -> dict:
     """One end-to-end runtime measurement: ops/sec and latency
     percentiles for get/set/create plus a watch fan-out, with
     ``n_clients`` concurrent clients against the in-process server.
 
     Modes: ``python`` (pure-Python scalar codec, the reference-idiom
     baseline), ``native`` (C++ frame scanner), ``ingest`` (batched
-    TPU decode via FleetIngest)."""
+    TPU decode via FleetIngest).  ``write_heavy`` flips the op mix to
+    SET_DATA/CREATE-dominated (the outbound-plane cell family, `make
+    bench-write`); every cell also scrapes the flush-batch-size
+    histograms (io/sendplane.py) from both planes."""
     import asyncio
 
     from zkstream_tpu import Client
+    from zkstream_tpu.io.sendplane import scrape_flush_cells
     from zkstream_tpu.server import ZKServer
 
     ingest = None
@@ -763,13 +768,14 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
         use_native = False
 
     loop = asyncio.get_running_loop()
-    srv = await ZKServer().start()
     # one shared collector: every client's per-op latency lands in the
     # same zookeeper_op_latency_ms histogram, scraped into the result
     # below so BENCH_*.json carries histogram-derived p50/p99 per op
-    # next to the workload-timed percentiles
+    # next to the workload-timed percentiles; the server shares it so
+    # both planes' flush-batch histograms land in the same scrape
     from zkstream_tpu.utils.metrics import Collector
     collector = Collector()
+    srv = await ZKServer(collector=collector).start()
     clients = [Client(address='127.0.0.1', port=srv.port,
                       session_timeout=30000, ingest=ingest,
                       use_native_codec=use_native,
@@ -779,7 +785,8 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
         c.start()
     await asyncio.gather(*[c.wait_connected(timeout=30)
                            for c in clients])
-    out = {'mode': mode, 'conns': n_clients}
+    out = {'mode': mode, 'conns': n_clients,
+           'workload': 'write' if write_heavy else 'mixed'}
     try:
         await clients[0].create('/b', b'x' * 64)
         if ingest is not None:
@@ -830,10 +837,6 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
                 'p50_ms': round(p50, 3), 'p99_ms': round(p99, 3)}
 
         per = max(8, OPS_TOTAL // n_clients)
-        await measure('get', lambda c, i: lambda: c.get('/b'), per)
-        await measure('set',
-                      lambda c, i: lambda: c.set('/b', b'y' * 64),
-                      per // 2)
         seqs = [0] * n_clients
 
         def mk_create(c, i):
@@ -841,7 +844,21 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
                 seqs[i] += 1
                 await c.create('/c%d-%d' % (i, seqs[i]), b'')
             return run
-        await measure('create', mk_create, per // 4)
+        if write_heavy:
+            # SET_DATA/CREATE-dominated: the outbound plane's shape
+            await measure('set',
+                          lambda c, i: lambda: c.set('/b', b'y' * 64),
+                          per)
+            await measure('create', mk_create, per // 2)
+            await measure('get', lambda c, i: lambda: c.get('/b'),
+                          per // 4)
+        else:
+            await measure('get', lambda c, i: lambda: c.get('/b'),
+                          per)
+            await measure('set',
+                          lambda c, i: lambda: c.set('/b', b'y' * 64),
+                          per // 2)
+            await measure('create', mk_create, per // 4)
 
         # watch fan-out: every client watches one node; one set fires
         # n_clients notifications + re-arm reads through the stack.
@@ -901,22 +918,30 @@ async def _client_ops_run(mode: str, n_clients: int) -> dict:
                 'p99_ms': round(hist.percentile(99, labels), 3),
             }
         out['op_latency_hist'] = ops_hist
+        # Flush-batch-size distributions (io/sendplane.py), both
+        # planes — the coalescing observability the write-heavy cells
+        # exist to publish.
+        out['flush_batches'] = scrape_flush_cells(collector)
     finally:
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()
     return out
 
 
-def bench_client_ops() -> None:
+def bench_client_ops(write_heavy: bool = False) -> None:
     """End-to-end runtime numbers (VERDICT r1 items 1/8): the full
     asyncio client stack against the in-process server, per codec
     mode.  Secondary metrics: printed to stderr, one JSON line per
     mode, after the flagship decode numbers are already measured (the
-    readbacks here would poison remote-TPU dispatch timing)."""
+    readbacks here would poison remote-TPU dispatch timing).
+
+    ``write_heavy`` runs the SET_DATA/CREATE-dominated cell family
+    instead (`make bench-write`); the headline op becomes ``set``."""
     import asyncio
 
     from zkstream_tpu.utils import native
 
+    headline = 'set' if write_heavy else 'get'
     modes = ['python']
     if native.ensure_lib() is not None:
         modes.append('native')
@@ -929,7 +954,8 @@ def bench_client_ops() -> None:
         for n in CLIENT_SCALES:
             for mode in modes:
                 try:
-                    r = asyncio.run(_client_ops_run(mode, n))
+                    r = asyncio.run(_client_ops_run(
+                        mode, n, write_heavy=write_heavy))
                 except Exception as e:
                     # a failed round must not kill the already-printed
                     # headline metric; the other round still reports
@@ -938,8 +964,8 @@ def bench_client_ops() -> None:
                     continue
                 key = (mode, n)
                 if (key not in results
-                        or r['get']['ops_per_sec']
-                        > results[key]['get']['ops_per_sec']):
+                        or r[headline]['ops_per_sec']
+                        > results[key][headline]['ops_per_sec']):
                     results[key] = r
     for n in CLIENT_SCALES:
         for mode in modes:
@@ -950,12 +976,13 @@ def bench_client_ops() -> None:
         cell = {m: results[(m, n)] for m in modes if (m, n) in results}
         if not cell:
             continue
-        base = cell.get('python', {}).get('get', {}).get('ops_per_sec')
-        best_mode = max(cell,
-                        key=lambda m: cell[m]['get']['ops_per_sec'])
-        best = cell[best_mode]['get']['ops_per_sec']
+        base = cell.get('python', {}).get(headline,
+                                          {}).get('ops_per_sec')
+        best_mode = max(
+            cell, key=lambda m: cell[m][headline]['ops_per_sec'])
+        best = cell[best_mode][headline]['ops_per_sec']
         print(json.dumps({
-            'metric': 'client_get_ops_per_sec',
+            'metric': 'client_%s_ops_per_sec' % (headline,),
             'conns': n,
             'value': best,
             'unit': 'ops/s',
@@ -1029,6 +1056,16 @@ def _guard_backend(timeout_s: float | None = None) -> None:
 
 
 def main() -> None:
+    if '--write' in sys.argv:
+        # `make bench-write`: the write-heavy client-ops cell family
+        # only — host-path, no accelerator probe, no flagship decode
+        # stages (their readbacks are unrelated to the outbound
+        # plane).  Pin CPU before jax initializes: a wedged tunneled
+        # accelerator must not stall a host-path bench.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_client_ops(write_heavy=True)
+        return
     _guard_backend()
     # Initialize the host CPU backend FIRST: the fleet ingest's
     # latency-aware placement wants it, and creating a second PJRT

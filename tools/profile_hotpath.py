@@ -1,13 +1,13 @@
-"""Profile the scalar (per-connection) decode hot path.
+"""Profile the scalar (per-connection) codec hot paths.
 
 Answers the question "where does the Python codec actually spend its
 time, and what native boundary does that justify?" — the methodology
 and conclusions are written up in PROFILE.md; this script reproduces
 them.
 
-Three tiers over the same GET_DATA reply stream (the dominant packet
-shape of a read-heavy ZK workload: 16-byte header + data buffer +
-68-byte Stat):
+Decode (default): three tiers over the same GET_DATA reply stream
+(the dominant packet shape of a read-heavy ZK workload: 16-byte
+header + data buffer + 68-byte Stat):
 
   framing   FrameDecoder only (what native/zkwire.cpp accelerates)
   python    full PacketCodec decode, pure Python
@@ -17,7 +17,19 @@ shape of a read-heavy ZK workload: 16-byte header + data buffer +
 plus a cProfile breakdown of the pure-Python tier, so the "jute
 primitive reads dominate" claim stays checkable as the code evolves.
 
+Encode (``--encode``): the send-side twin, per PROFILE.md "Encode
+side".  Three tiers over the steady-state write shapes — the GET_DATA
+reply (server direction) and the SET_DATA request (client direction):
+
+  per-field  records.write_* walking a JuteWriter one primitive at a
+             time (the round-1 idiom; ZKSTREAM_NO_FASTENC forces it
+             in production code)
+  fast       protocol/fastencode.py single-pass struct-batched
+             encoders
+  ext        the C encoders in native/zkwire_ext.c, when buildable
+
 Usage:  python tools/profile_hotpath.py [--frames N] [--reps N]
+                                        [--encode]
 """
 
 from __future__ import annotations
@@ -75,11 +87,84 @@ def measure(fn, stream: bytes, frames: int, reps: int) -> float:
     return len(stream) / best / (1 << 20)
 
 
+def mk_encode_corpora(frames: int, data_len: int = 64):
+    """The two steady-state write shapes: GET_DATA replies (server
+    direction) and SET_DATA requests (client direction)."""
+    st = records.Stat(1, 2, 3, 4, 5, 6, 7, 0, data_len, 0, 8)
+    replies = [
+        {'xid': i + 1, 'zxid': 1000 + i, 'opcode': 'GET_DATA',
+         'err': 'OK', 'data': b'd' * data_len, 'stat': st}
+        for i in range(frames)]
+    requests = [
+        {'xid': i + 1, 'opcode': 'SET_DATA', 'path': '/bench/node',
+         'data': b'd' * data_len, 'version': -1}
+        for i in range(frames)]
+    return (('GET_DATA reply', True, replies),
+            ('SET_DATA request', False, requests))
+
+
+def measure_encode(fn, pkts, reps: int):
+    """Best-of-trials (MiB/s, us/frame) for one encoder over a packet
+    corpus (same min-over-interleaved-trials discipline as decode)."""
+    nbytes = sum(len(fn(dict(p))) for p in pkts)
+    best = float('inf')
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for p in pkts:
+                fn(p)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return nbytes / best / (1 << 20), best / len(pkts) * 1e6
+
+
+def run_encode_profile(frames: int, reps: int) -> None:
+    from zkstream_tpu.protocol.fastencode import FastEncoder
+    from zkstream_tpu.protocol.framing import frame
+    from zkstream_tpu.protocol.jute import JuteWriter
+
+    ext = native.ensure_ext()
+    if ext is None:
+        print('C extension unavailable; skipping ext tier')
+    for shape, server, pkts in mk_encode_corpora(frames):
+        wire = records.write_response if server \
+            else records.write_request
+
+        def per_field(pkt):
+            w = JuteWriter()
+            wire(w, pkt)
+            return frame(w.to_bytes())
+
+        fast = FastEncoder()
+        fast_fn = (fast.encode_response if server
+                   else fast.encode_request)
+        tiers = [('per-field (JuteWriter)', per_field),
+                 ('single-pass (python)', fast_fn)]
+        if ext is not None:
+            tiers.append(('C extension',
+                          ext.encode_response if server
+                          else ext.encode_request))
+        sample = dict(pkts[0])
+        print('%s (%d B framed, %d frames):'
+              % (shape, len(per_field(sample)), len(pkts)))
+        for name, fn in tiers:
+            assert fn(dict(pkts[0])) == per_field(dict(pkts[0])), \
+                'tier %r diverges from the spec bytes' % (name,)
+            mibs, us = measure_encode(fn, pkts, reps)
+            print('  %-22s %8.1f MiB/s  (%.2f us/frame)'
+                  % (name, mibs, us))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--frames', type=int, default=2000)
     ap.add_argument('--reps', type=int, default=20)
+    ap.add_argument('--encode', action='store_true',
+                    help='profile the encode tiers instead of decode')
     args = ap.parse_args()
+
+    if args.encode:
+        run_encode_profile(args.frames, args.reps)
+        return
 
     stream = mk_stream(args.frames)
     print('stream: %d frames, %d bytes' % (args.frames, len(stream)))

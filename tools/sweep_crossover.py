@@ -12,10 +12,23 @@ fleet sizes x client receive paths (VERDICT r2 item 1):
              device framing + plane assembly — the no-native-toolchain
              regime (only an interpreted host codec available)
 
-Workloads per cell: concurrent gets (per-op latency), and a
-notification fan-out storm (every connection watches one node; one set
-fires N notifications + N re-arm reads through the stack) — the
-fleet-scale workload the batcher exists for.
+Any mode takes a ``-nocork`` suffix (e.g. ``native-nocork``): same
+codec path with the outbound tick-cork (io/sendplane.py) disabled on
+both the clients and the in-process server — isolates the cork.  A
+``-legacy`` suffix additionally disables the single-pass Python
+encode tier (ZKSTREAM_NO_FASTENC): cork off + per-field JuteWriter
+encode, i.e. the pre-outbound-plane path for that codec mode.
+
+Workloads per cell (``--workload``): ``get`` (default) runs
+concurrent gets plus a notification fan-out storm; ``write`` is
+SET_DATA/CREATE-dominated (2 sets : 1 create), the shape the
+outbound-plane work targets.
+
+Every cell also reports the flush-batch-size distributions
+(zookeeper_flush_batch_frames/_bytes, client and server planes) and —
+for ingest modes — the ingest tick-duration histogram
+(zkstream_ingest_tick_ms p50/p99), so regime flips show as
+distribution shifts, not just tick counts.
 
 Emits one JSON line per cell to stdout; run via
   python tools/sweep_crossover.py [--conns 32,256] [--modes ...]
@@ -53,9 +66,23 @@ def _pct(xs, p):
     return xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))]
 
 
-async def run_cell(mode: str, n_conns: int) -> dict:
+async def run_cell(mode: str, n_conns: int,
+                   workload: str = 'get') -> dict:
     from zkstream_tpu import Client
+    from zkstream_tpu.io.sendplane import scrape_flush_cells
     from zkstream_tpu.server import ZKServer
+    from zkstream_tpu.utils.metrics import Collector
+
+    cork = None
+    legacy = False
+    cell_mode = mode
+    if mode.endswith('-legacy'):
+        cork = False
+        legacy = True
+        mode = mode[:-len('-legacy')]
+    elif mode.endswith('-nocork'):
+        cork = False
+        mode = mode[:-len('-nocork')]
 
     ingest = None
     kw: dict = {}
@@ -93,15 +120,25 @@ async def run_cell(mode: str, n_conns: int) -> dict:
         raise ValueError(mode)
 
     loop = asyncio.get_running_loop()
-    srv = await ZKServer().start()
+    # -legacy: per-field JuteWriter encode (codecs read the env at
+    # construction, which happens while the cell's clients connect)
+    prev_fastenc = os.environ.get('ZKSTREAM_NO_FASTENC')
+    if legacy:
+        os.environ['ZKSTREAM_NO_FASTENC'] = '1'
+    collector = Collector()
+    if ingest is not None:
+        ingest.bind_metrics(collector)
+    srv = await ZKServer(cork=cork, collector=collector).start()
     clients = [Client(address='127.0.0.1', port=srv.port,
-                      session_timeout=60000, ingest=ingest, **kw)
+                      session_timeout=60000, ingest=ingest, cork=cork,
+                      collector=collector, **kw)
                for _ in range(n_conns)]
     for c in clients:
         c.start()
     await asyncio.gather(*[c.wait_connected(timeout=60)
                            for c in clients])
-    out = {'mode': mode, 'conns': n_conns}
+    out = {'mode': cell_mode, 'conns': n_conns,
+           'workload': workload}
     try:
         await clients[0].create('/b', b'x' * 64)
         if ingest is not None:
@@ -118,6 +155,33 @@ async def run_cell(mode: str, n_conns: int) -> dict:
         # warm steady state
         for _ in range(3):
             await asyncio.gather(*[c.get('/b') for c in clients])
+
+        if workload == 'write':
+            # -- SET_DATA/CREATE-dominated (2 sets : 1 create) --
+            per = max(6, GETS_TOTAL // n_conns)
+            lat = []
+
+            async def writer(c, ci):
+                seq = 0
+                for i in range(per):
+                    t0 = loop.time()
+                    if i % 3 == 2:
+                        seq += 1
+                        await c.create('/wr%d-%d' % (ci, seq), b'')
+                    else:
+                        await c.set('/b', b'y' * 64, version=-1)
+                    lat.append((loop.time() - t0) * 1000.0)
+            t0 = loop.time()
+            await asyncio.gather(*[writer(c, i)
+                                   for i, c in enumerate(clients)])
+            dt = loop.time() - t0
+            out['write'] = {
+                'ops_per_sec': round(len(lat) / dt, 1),
+                'p50_ms': round(_pct(lat, 50), 3),
+                'p99_ms': round(_pct(lat, 99), 3)}
+            out['flush'] = scrape_flush_cells(collector)
+            _scrape_ingest(out, ingest, collector)
+            return out
 
         # -- concurrent gets --
         per = max(4, GETS_TOTAL // n_conns)
@@ -171,19 +235,43 @@ async def run_cell(mode: str, n_conns: int) -> dict:
             'events': n_conns,
             'best_events_per_sec': round(n_conns / best, 1),
             'best_ms': round(best * 1000.0, 2)}
-        if ingest is not None:
-            out['ingest'] = {
-                'ticks': ingest.ticks,
-                'scalar_ticks': ingest.ticks_scalar,
-                'warming_ticks': ingest.ticks_warming,
-                'frag_ticks': ingest.ticks_frag,
-                'frames': ingest.frames_routed,
-                'frames_per_tick': round(
-                    ingest.frames_routed / max(1, ingest.ticks), 1)}
+        out['flush'] = scrape_flush_cells(collector)
+        _scrape_ingest(out, ingest, collector)
     finally:
+        if legacy:
+            if prev_fastenc is None:
+                os.environ.pop('ZKSTREAM_NO_FASTENC', None)
+            else:
+                os.environ['ZKSTREAM_NO_FASTENC'] = prev_fastenc
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()
     return out
+
+
+def _scrape_ingest(out: dict, ingest, collector) -> None:
+    """Ingest cell stats: routing counters plus the tick-duration
+    DISTRIBUTION (zkstream_ingest_tick_ms) — a regime flip must show
+    as a latency-shape shift, not only a tick-count shift."""
+    if ingest is None:
+        return
+    out['ingest'] = {
+        'ticks': ingest.ticks,
+        'scalar_ticks': ingest.ticks_scalar,
+        'warming_ticks': ingest.ticks_warming,
+        'frag_ticks': ingest.ticks_frag,
+        'frames': ingest.frames_routed,
+        'frames_per_tick': round(
+            ingest.frames_routed / max(1, ingest.ticks), 1)}
+    try:
+        th = collector.get_collector('zkstream_ingest_tick_ms')
+    except ValueError:
+        return
+    n = th.count()
+    if n:
+        out['ingest']['tick_ms'] = {
+            'count': n,
+            'p50': round(th.percentile(50), 3),
+            'p99': round(th.percentile(99), 3)}
 
 
 def _sign_test_p(wins: int, losses: int) -> float:
@@ -200,7 +288,7 @@ def _sign_test_p(wins: int, losses: int) -> float:
 
 
 def run_paired(mode_a: str, mode_b: str, conns: list[int],
-               rounds: int) -> None:
+               rounds: int, workload: str = 'get') -> None:
     """Paired comparison (VERDICT r4 next #5): run the two modes
     back-to-back within each round — adjacent in time, same host
     conditions — and judge each fleet size on the per-round SIGN of
@@ -210,15 +298,21 @@ def run_paired(mode_a: str, mode_b: str, conns: list[int],
     exact sign-test p-value, and the dispatch-policy routing fractions
     (how often the guard/threshold actually sent ticks to the scalar
     drain)."""
+    metric = 'write' if workload == 'write' else 'get'
     deltas: dict[int, list[float]] = {n: [] for n in conns}
     routing: dict[int, dict] = {}
+    #: n -> plane -> [frames, flushes] pooled over EVERY round of
+    #: mode_a (a last-round sample would misrepresent the batch-size
+    #: distribution the summary line is cited for; full per-round
+    #: percentiles stay on the '#' cell lines)
+    flush_acc: dict[int, dict] = {}
     for rnd in range(rounds):
         for n in conns:
             cell = {}
             for mode in (mode_a, mode_b):
                 t0 = time.time()
                 try:
-                    r = asyncio.run(run_cell(mode, n))
+                    r = asyncio.run(run_cell(mode, n, workload))
                 except Exception as e:
                     r = {'mode': mode, 'conns': n, 'error': repr(e)}
                 r['cell_s'] = round(time.time() - t0, 1)
@@ -228,8 +322,13 @@ def run_paired(mode_a: str, mode_b: str, conns: list[int],
             a, b = cell[mode_a], cell[mode_b]
             if 'error' in a or 'error' in b:
                 continue
-            ops_a = a['get']['ops_per_sec']
-            ops_b = b['get']['ops_per_sec']
+            for plane, st in (a.get('flush') or {}).items():
+                row = flush_acc.setdefault(n, {}).setdefault(
+                    plane, [0.0, 0])
+                row[0] += st['frames_mean'] * st['flushes']
+                row[1] += st['flushes']
+            ops_a = a[metric]['ops_per_sec']
+            ops_b = b[metric]['ops_per_sec']
             if ops_b <= 0 or ops_a <= 0:   # a silently idle cell must
                 continue                   # skip its pair, not void
                                            # the whole sweep
@@ -251,6 +350,7 @@ def run_paired(mode_a: str, mode_b: str, conns: list[int],
         mean = sum(ds) / len(ds) if ds else 0.0
         print(json.dumps({
             'paired': '%s-vs-%s' % (mode_a, mode_b),
+            'workload': workload,
             'conns': n,
             'pairs': len(ds),
             'wins': wins,
@@ -259,6 +359,10 @@ def run_paired(mode_a: str, mode_b: str, conns: list[int],
             'deltas_pct': [round(d, 2) for d in ds],
             'sign_p': round(_sign_test_p(wins, losses), 4),
             'routing': routing.get(n),
+            'flush': {plane: {'flushes': int(row[1]),
+                              'frames_mean': round(row[0] / row[1], 2)}
+                      for plane, row in flush_acc.get(n, {}).items()
+                      if row[1]} or None,
         }), flush=True)
 
 
@@ -273,24 +377,30 @@ def main() -> None:
                          'noise swings single runs +-30%%)')
     ap.add_argument('--paired', default=None, metavar='A,B',
                     help='paired-design comparison of exactly two '
-                         'modes (e.g. ingest-auto,native): per-round '
-                         'deltas + exact sign test per fleet size')
+                         'modes (e.g. ingest-auto,native or '
+                         'native,native-nocork): per-round deltas + '
+                         'exact sign test per fleet size')
+    ap.add_argument('--workload', default='get',
+                    choices=('get', 'write'),
+                    help='get: concurrent gets + fan-out storm; '
+                         'write: SET_DATA/CREATE-dominated')
     args = ap.parse_args()
     global MAX_FRAMES
     MAX_FRAMES = args.max_frames
     conns = [int(x) for x in args.conns.split(',')]
     if args.paired:
         mode_a, mode_b = args.paired.split(',')
-        run_paired(mode_a, mode_b, conns, args.rounds)
+        run_paired(mode_a, mode_b, conns, args.rounds, args.workload)
         return
     modes = args.modes.split(',')
     best: dict = {}
+    metric = 'write' if args.workload == 'write' else 'get'
     for rnd in range(args.rounds):
         for n in conns:
             for mode in modes:
                 t0 = time.time()
                 try:
-                    r = asyncio.run(run_cell(mode, n))
+                    r = asyncio.run(run_cell(mode, n, args.workload))
                 except Exception as e:
                     r = {'mode': mode, 'conns': n, 'error': repr(e)}
                 r['cell_s'] = round(time.time() - t0, 1)
@@ -300,8 +410,8 @@ def main() -> None:
                 if 'error' in r:
                     best.setdefault(key, r)
                 elif (key not in best or 'error' in best[key]
-                        or r['get']['ops_per_sec']
-                        > best[key]['get']['ops_per_sec']):
+                        or r[metric]['ops_per_sec']
+                        > best[key][metric]['ops_per_sec']):
                     best[key] = r
     for n in conns:
         for mode in modes:
