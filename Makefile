@@ -7,7 +7,7 @@ PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check native bench asan chaos chaos-ensemble obs \
-    coverage clean
+    durability bench-wal coverage clean
 
 all: check test
 
@@ -32,6 +32,25 @@ chaos:
 # --seed N`; scale with ZKSTREAM_CHAOS_ENS_TIER1 / _SEED.
 chaos-ensemble:
 	$(PYTHON) -m pytest tests/test_chaos_ensemble.py -q -m 'not slow'
+
+# Durability plane (server/persist.py; README "Durability"): the WAL
+# unit corpus (torn-write truncation at every byte offset, bit-flip
+# CRC rejection, rotation/snapshot recovery, sync policies) plus the
+# ensemble tier-1 slice — whose every schedule now ends with a
+# full-ensemble SIGKILL crash image and a restart-from-disk recovery
+# checked by the invariant engine (invariant 6, io/invariants.py).
+durability:
+	$(PYTHON) -m pytest tests/test_wal.py tests/test_chaos_ensemble.py \
+	    -q -m 'not slow'
+
+# Paired durability-cost envelope: wal-off vs sync=tick (group
+# commit) vs sync=always write-heavy cells at fleet 16/64 with
+# fsync-latency histograms per cell and exact sign tests (table in
+# PROFILE.md "Durability plane").  Rounds via ZKSTREAM_BENCH_WAL_ROUNDS;
+# WAL device via ZKSTREAM_BENCH_WAL_DIR (default tmpfs — measure the
+# plane, not this image's 9p filesystem).
+bench-wal:
+	$(PYTHON) bench.py --wal
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), xid-correlated op tracing, and the four-letter admin

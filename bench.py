@@ -24,6 +24,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import struct
 import sys
 import time
@@ -733,7 +734,8 @@ def _percentiles(lat_ms):
 
 
 async def _client_ops_run(mode: str, n_clients: int,
-                          write_heavy: bool = False) -> dict:
+                          write_heavy: bool = False,
+                          wal: str | None = None) -> dict:
     """One end-to-end runtime measurement: ops/sec and latency
     percentiles for get/set/create plus a watch fan-out, with
     ``n_clients`` concurrent clients against the in-process server.
@@ -743,8 +745,14 @@ async def _client_ops_run(mode: str, n_clients: int,
     TPU decode via FleetIngest).  ``write_heavy`` flips the op mix to
     SET_DATA/CREATE-dominated (the outbound-plane cell family, `make
     bench-write`); every cell also scrapes the flush-batch-size
-    histograms (io/sendplane.py) from both planes."""
+    histograms (io/sendplane.py) from both planes.  ``wal`` attaches
+    the durability plane (server/persist.py) at that fsync policy
+    ('tick' | 'always' | 'never'; None = off — the `make bench-wal`
+    paired family) and scrapes its fsync-latency histogram into the
+    cell."""
     import asyncio
+    import shutil
+    import tempfile
 
     from zkstream_tpu import Client
     from zkstream_tpu.io.sendplane import scrape_flush_cells
@@ -775,7 +783,28 @@ async def _client_ops_run(mode: str, n_clients: int,
     # both planes' flush-batch histograms land in the same scrape
     from zkstream_tpu.utils.metrics import Collector
     collector = Collector()
-    srv = await ZKServer(collector=collector).start()
+    # WAL cells default to tmpfs (/dev/shm) when available: the paired
+    # family isolates the durability PLANE's cost (encode + CRC32C +
+    # group-commit machinery + ack gating) from the ambient device —
+    # this image's 9p filesystem syncs at ~0.6 ms, an artifact of the
+    # container, not of the design.  Point ZKSTREAM_BENCH_WAL_DIR at a
+    # real data dir to measure a device-bound envelope instead; either
+    # way the cell's fsync-latency histogram says which device it saw.
+    wal_dir = None
+    db = None
+    if wal:
+        base = os.environ.get('ZKSTREAM_BENCH_WAL_DIR') or (
+            '/dev/shm' if os.path.isdir('/dev/shm') else None)
+        wal_dir = tempfile.mkdtemp(prefix='zkbench-wal-', dir=base)
+    else:
+        # the off/baseline arm must stay WAL-free even when the
+        # ambient ZKSTREAM_WAL_DIR default is set — an explicit db
+        # skips the server's env resolution (and a shared ambient dir
+        # would leak state between rounds on top of it)
+        from zkstream_tpu.server import ZKDatabase
+        db = ZKDatabase()
+    srv = await ZKServer(db=db, collector=collector, wal_dir=wal_dir,
+                         durability=wal).start()
     clients = [Client(address='127.0.0.1', port=srv.port,
                       session_timeout=30000, ingest=ingest,
                       use_native_codec=use_native,
@@ -787,6 +816,8 @@ async def _client_ops_run(mode: str, n_clients: int,
                            for c in clients])
     out = {'mode': mode, 'conns': n_clients,
            'workload': 'write' if write_heavy else 'mixed'}
+    if wal:
+        out['wal'] = wal
     try:
         await clients[0].create('/b', b'x' * 64)
         if ingest is not None:
@@ -922,9 +953,17 @@ async def _client_ops_run(mode: str, n_clients: int,
         # planes — the coalescing observability the write-heavy cells
         # exist to publish.
         out['flush_batches'] = scrape_flush_cells(collector)
+        if wal:
+            from zkstream_tpu.server.persist import scrape_wal_cells
+            out['wal_stats'] = scrape_wal_cells(collector)
+            out['wal_stats']['sync_errors'] = srv.db.wal.sync_errors
     finally:
         await asyncio.gather(*[c.close() for c in clients])
         await srv.stop()
+        if srv.db.wal is not None:
+            srv.db.wal.close()
+        if wal_dir is not None:
+            shutil.rmtree(wal_dir, ignore_errors=True)
     return out
 
 
@@ -989,6 +1028,76 @@ def bench_client_ops(write_heavy: bool = False) -> None:
             'vs_baseline': round(best / base, 3) if base else None,
             'mode': best_mode,
         }), file=sys.stderr)
+
+
+#: `bench.py --wal` fleet sizes (the acceptance envelope: sync=tick
+#: must not be significantly slower than wal-off at 16 and 64).
+WAL_SCALES = (16, 64)
+WAL_ARMS = (None, 'tick', 'always')
+
+
+def bench_wal() -> None:
+    """The durability plane's cost envelope (`make bench-wal`):
+    paired write-heavy cells — wal-off vs sync=tick (group commit:
+    one fsync per tick, riding the send-plane cork) vs sync=always
+    (one fsync per txn) — at fleet 16/64, with the fsync-latency
+    histogram scraped into every wal cell.  Per-round adjacent A/B/C
+    runs, sign of the per-round headline (set ops/s) delta, exact
+    two-sided sign test; the measured table lives in PROFILE.md
+    "Durability plane"."""
+    import asyncio
+
+    from zkstream_tpu.utils import native
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    mode = 'native' if native.ensure_lib() is not None else 'python'
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_WAL_ROUNDS', '10'))
+    # rows[(conns, arm)] -> list of per-round set-ops/s
+    rows: dict = {}
+    cells: dict = {}
+    for rnd in range(rounds):
+        for n in WAL_SCALES:
+            for arm in WAL_ARMS:
+                try:
+                    r = asyncio.run(_client_ops_run(
+                        mode, n, write_heavy=True, wal=arm))
+                except Exception as e:
+                    print('# wal cell %s@%d round failed: %r'
+                          % (arm or 'off', n, e), file=sys.stderr)
+                    continue
+                key = (n, arm or 'off')
+                rows.setdefault(key, []).append(
+                    r['set']['ops_per_sec'])
+                if key not in cells or r['set']['ops_per_sec'] > \
+                        cells[key]['set']['ops_per_sec']:
+                    cells[key] = r
+    for key in sorted(cells, key=str):
+        print('# wal_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for n in WAL_SCALES:
+        for a_arm, b_arm, label in (
+                ('tick', 'off', 'tick-vs-off'),
+                ('always', 'tick', 'always-vs-tick'),
+                ('always', 'off', 'always-vs-off')):
+            a = rows.get((n, a_arm), [])
+            b = rows.get((n, b_arm), [])
+            if not a or not b:
+                continue
+            paired = list(zip(a, b))
+            deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+            wins = sum(1 for x, y in paired if x > y)
+            losses = sum(1 for x, y in paired if x < y)
+            print(json.dumps({
+                'metric': 'wal_group_commit_sign_test',
+                'pair': label,
+                'conns': n,
+                'rounds': len(paired),
+                'wins': wins,
+                'losses': losses,
+                'mean_delta_pct': round(sum(deltas)
+                                        / max(1, len(deltas)), 1),
+                'sign_p': round(sign_test_p(wins, losses), 4),
+            }), flush=True)
 
 
 def _guard_backend(timeout_s: float | None = None) -> None:
@@ -1056,6 +1165,14 @@ def _guard_backend(timeout_s: float | None = None) -> None:
 
 
 def main() -> None:
+    if '--wal' in sys.argv:
+        # `make bench-wal`: the paired durability-plane cell family
+        # (wal-off vs sync=tick vs sync=always, write-heavy).  Host-
+        # path only, same rationale as --write.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_wal()
+        return
     if '--write' in sys.argv:
         # `make bench-write`: the write-heavy client-ops cell family
         # only — host-path, no accelerator probe, no flagship decode

@@ -2,13 +2,27 @@
 tests/test_process_ensemble.py; not collected by pytest).
 
 Roles:
-  leader                 — ZKDatabase + leader-member ZKServer +
+  leader [wal_dir [sync]]
+                         — ZKDatabase + leader-member ZKServer +
                            ReplicationService; prints
-                           ``READY <client_port> <repl_port>``.
-  follower <host> <port> — RemoteLeader control/events channels to the
+                           ``READY <client_port> <repl_port>``.  With
+                           a ``wal_dir`` the database is RECOVERED
+                           from it (newest valid snapshot + replayed
+                           log tail, server/persist.py) and every
+                           committed txn is logged before its ack —
+                           a respawned leader over the same dir is
+                           restart-from-disk after SIGKILL.
+  follower <host> <port> [wal_dir [sync]]
+                         — RemoteLeader control/events channels to the
                            leader's replication port + a full ZKServer
                            serving clients from a RemoteReplicaStore;
-                           prints ``READY <client_port>``.
+                           prints ``READY <client_port>``.  With a
+                           ``wal_dir`` the mirror is logged as it
+                           lands, and a respawned follower recovers
+                           its tree from disk and rejoins with the
+                           recovered zxid as the replication catch-up
+                           base (tail-only resync) instead of an
+                           empty-tree snapshot fetch.
 
 Both run until killed — being SIGKILLed mid-service is the point of
 the tier (reference: test/multi-node.test.js:309-338 kills real server
@@ -21,27 +35,71 @@ import os
 import sys
 
 
-async def run_leader() -> None:
+async def run_leader(wal_dir: str | None = None,
+                     sync: str = 'tick') -> None:
     from zkstream_tpu.server.replication import ReplicationService
     from zkstream_tpu.server.server import ZKServer
     from zkstream_tpu.server.store import ZKDatabase
 
-    db = ZKDatabase()
+    if wal_dir:
+        from zkstream_tpu.server.persist import open_wal_database
+        db = open_wal_database(wal_dir, sync=sync)
+    else:
+        db = ZKDatabase()
     member = await ZKServer(db).start()
     repl = await ReplicationService(db).start()
     print('READY %d %d' % (member.port, repl.port), flush=True)
     await asyncio.Event().wait()
 
 
-async def run_follower(leader_host: str, leader_port: int) -> None:
+async def run_follower(leader_host: str, leader_port: int,
+                       wal_dir: str | None = None,
+                       sync: str = 'tick') -> None:
     from zkstream_tpu.server.replication import (
         RemoteLeader,
         RemoteReplicaStore,
     )
     from zkstream_tpu.server.server import ZKServer
 
-    remote = await RemoteLeader(leader_host, leader_port).connect()
-    store = RemoteReplicaStore(remote, lag=0.0)
+    recovered = None
+    have_zxid = None
+    if wal_dir:
+        from zkstream_tpu.server.persist import recover_state
+        rec = recover_state(wal_dir)
+        if rec.last_index or rec.snapshot_index >= 0:
+            recovered = {'zxid': rec.zxid, 'nodes': rec.nodes}
+            have_zxid = rec.zxid
+    remote = await RemoteLeader(leader_host, leader_port,
+                                have_zxid=have_zxid).connect()
+    store = RemoteReplicaStore(remote, lag=0.0, recovered=recovered)
+    if wal_dir:
+        from zkstream_tpu.server.persist import (
+            WriteAheadLog,
+            entry_zxid,
+            reset_dir,
+        )
+        if not remote.resynced:
+            # snapshot bootstrap (or fresh join): the on-disk history
+            # is stale relative to the installed image — reset and
+            # re-anchor on a snapshot of what the leader shipped
+            reset_dir(wal_dir)
+        wal = WriteAheadLog(wal_dir, sync=sync)
+        # fuzzy snapshots serialize the replica's tree; gate them on
+        # the replica having applied everything mirrored so an image
+        # can never stamp entries the tree does not hold
+        wal.bind(store)
+        wal.snapshot_gate = (
+            lambda: store.applied == remote.log_end())
+        with remote._mirror_lock:
+            # entries mirrored while connecting predate the WAL
+            # attach: log them first or the on-disk zxid run would
+            # hold a silent gap
+            for e in remote.log:
+                if entry_zxid(e) > wal.last_zxid:
+                    wal.append(e)
+            remote.wal = wal
+        if not remote.resynced:
+            wal.snapshot_now()
     member = await ZKServer(remote, store=store).start()
     print('READY %d' % (member.port,), flush=True)
     await asyncio.Event().wait()
@@ -55,10 +113,11 @@ def main() -> int:
         os.path.abspath(__file__))))
     role = sys.argv[1]
     if role == 'leader':
-        asyncio.run(run_leader())
+        asyncio.run(run_leader(*sys.argv[2:4]))
     else:
         assert role == 'follower', role
-        asyncio.run(run_follower(sys.argv[2], int(sys.argv[3])))
+        asyncio.run(run_follower(sys.argv[2], int(sys.argv[3]),
+                                 *sys.argv[4:6]))
     return 0
 
 

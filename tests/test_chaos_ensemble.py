@@ -80,6 +80,15 @@ def test_plan_space_is_covered():
     assert {p.session_timeout for p in plans} == {2000, 4000, 8000}
     assert any(p.decoherence_ms is not None for p in plans)
     assert any(p.config.p_ingest_hold > 0 for p in plans)
+    # the durability plane's draws (appended after the existing
+    # fields, so they never perturbed the plan shapes above): both
+    # fsync policies appear, segment sizes vary (small ones force
+    # rotation + fuzzy snapshots mid-schedule), and disk faults fire
+    # on some seeds
+    assert {p.durability for p in plans} == {'tick', 'always'}
+    assert len({p.wal_segment_bytes for p in plans}) >= 2
+    assert any(p.config.p_fsync_delay > 0 for p in plans)
+    assert any(p.config.p_fsync_error > 0 for p in plans)
 
 
 # -- the invariant engine itself ---------------------------------------
@@ -298,6 +307,28 @@ def _campaign_failure_report(bad) -> str:
         lines.append('  span ring (oldest first):')
         lines.append(format_spans(r.trace, limit=40))
     return '\n'.join(lines)
+
+
+@pytest.mark.timeout(90)
+async def test_kill_recover_rides_every_schedule():
+    """The durability plane's kill/recover pass (invariant 6) runs
+    inside every ensemble schedule — within the existing tier-1
+    budget, not on top of it: the schedule ends with a full-ensemble
+    SIGKILL crash image cut at an injector-chosen fsync window, a
+    restart-from-disk recovery, and the acked-write check against the
+    recovered tree.  Verify the machinery actually engaged: the
+    member timeline carries the sigkill-recover event and the span
+    ring carries the recovery span."""
+    r = await run_ensemble_schedule(BASE_SEED)
+    assert r.ok, r.violations
+    assert any(str(e['event']).startswith('sigkill-recover')
+               for e in r.member_events), r.member_events
+    assert any(s.get('op') == 'WAL_RECOVER' for s in r.trace)
+    # acks are zxid-stamped so the invariant's fsync-error floor can
+    # demote exactly the non-durable suffix
+    acks = [rec for rec in r.history if rec['kind'] == 'ack']
+    if acks:
+        assert all(rec.get('zxid') for rec in acks), acks[:3]
 
 
 @pytest.mark.timeout(180)

@@ -233,6 +233,78 @@ async def test_cli_watch_session_expiry_is_an_error_exit(
     assert rc == 1 and 'session expired' in err
 
 
+def _wal_fixture_dir(tmp_path, segment_bytes=300):
+    """A closed WAL dir with a few segments and a snapshot."""
+    from zkstream_tpu.server.persist import open_wal_database
+
+    d = str(tmp_path / 'wal')
+
+    async def build():
+        db = open_wal_database(d, sync='always',
+                               segment_bytes=segment_bytes)
+        for i in range(10):
+            db.create('/w%d' % i, b'v%d' % i, None, 0, None)
+        db.set_data('/w0', b'updated', -1)
+        db.delete('/w9', -1)
+        db.wal.close()
+    asyncio.new_event_loop().run_until_complete(build())
+    return d
+
+
+def test_cli_wal_dump_and_verify(tmp_path, capsys):
+    d = _wal_fixture_dir(tmp_path)
+    rc = cli.main(['wal', d])
+    out, err = capsys.readouterr()
+    assert rc == 0, err
+    assert 'segments:' in out and 'wal.' in out
+    assert 'snapshots:' in out
+    assert 'recovery:' in out and 'zxid 12' in out
+    assert 'status: clean' in out
+    # --records lists decoded ops with index/zxid/path
+    rc = cli.main(['wal', d, '--records'])
+    out, _ = capsys.readouterr()
+    assert rc == 0
+    assert 'create' in out and '/w3' in out
+    assert 'delete' in out and 'set_data' in out
+
+
+def test_cli_wal_reports_corruption(tmp_path, capsys):
+    d = _wal_fixture_dir(tmp_path)
+    segs = sorted(f for f in os.listdir(d) if f.startswith('wal.'))
+    # flip a byte in the FIRST segment: mid-log corruption, exit 1
+    p = os.path.join(d, segs[0])
+    blob = bytearray(open(p, 'rb').read())
+    blob[20] ^= 0xFF
+    open(p, 'wb').write(bytes(blob))
+    rc = cli.main(['wal', d])
+    out, err = capsys.readouterr()
+    assert rc == 1
+    assert 'crc@' in out or 'corrupt@' in out
+    assert 'STRUCTURAL CORRUPTION' in err
+
+
+def test_cli_wal_torn_final_record_is_clean(tmp_path, capsys):
+    """A torn FINAL record is the normal crash signature: reported,
+    tolerated, exit 0 — exactly recovery's contract."""
+    d = _wal_fixture_dir(tmp_path, segment_bytes=1 << 20)
+    segs = sorted(f for f in os.listdir(d) if f.startswith('wal.'))
+    p = os.path.join(d, segs[-1])
+    size = os.path.getsize(p)
+    with open(p, 'r+b') as f:
+        f.truncate(size - 3)
+    rc = cli.main(['wal', d])
+    out, err = capsys.readouterr()
+    assert rc == 0, err
+    assert 'torn@' in out
+    assert 'torn final record tolerated' in out
+
+
+def test_cli_wal_empty_dir_errors(tmp_path, capsys):
+    rc = cli.main(['wal', str(tmp_path)])
+    _, err = capsys.readouterr()
+    assert rc == 1 and 'no WAL state' in err
+
+
 @pytest.mark.timeout(150)
 async def test_cli_main_entry_via_subprocess(server):
     """python -m zkstream_tpu: the real __main__/main()/argv path,

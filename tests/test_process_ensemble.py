@@ -215,6 +215,123 @@ async def test_killed_follower_replaced_by_fresh_process(
 
 
 @pytest.mark.timeout(120)
+async def test_leader_sigkill_restart_from_disk(tmp_path):
+    """The durability plane's headline at the OS-process tier: the
+    LEADER process — the quorum itself, whose death previously lost
+    every acked write — is SIGKILLed and respawned over its WAL dir
+    (server/persist.py), and every acked write is back.  Two
+    generations deep, so recovery-of-a-recovered-log is covered."""
+    wal_dir = str(tmp_path / 'leader-wal')
+    leader = _spawn('leader', wal_dir)
+    c = _client([('127.0.0.1', leader.ports[0])])
+    try:
+        await c.wait_connected(timeout=10)
+        for i in range(10):
+            await c.create('/d%d' % i, b'gen0-%d' % i)
+        await c.set('/d0', b'gen0-final')
+    finally:
+        await c.close()
+
+    # the OS severs everything; RAM is gone
+    os.kill(leader.proc.pid, signal.SIGKILL)
+    leader.proc.wait()
+    leader.proc.stdout.close()
+
+    leader2 = _spawn('leader', wal_dir)
+    c2 = _client([('127.0.0.1', leader2.ports[0])])
+    try:
+        await c2.wait_connected(timeout=10)
+        data, stat = await c2.get('/d0')
+        assert bytes(data) == b'gen0-final' and stat.version == 1
+        for i in range(1, 10):
+            data, _ = await c2.get('/d%d' % i)
+            assert bytes(data) == b'gen0-%d' % i
+        await c2.create('/gen1', b'after-recovery')
+    finally:
+        await c2.close()
+
+    os.kill(leader2.proc.pid, signal.SIGKILL)
+    leader2.proc.wait()
+    leader2.proc.stdout.close()
+
+    leader3 = _spawn('leader', wal_dir)
+    c3 = _client([('127.0.0.1', leader3.ports[0])])
+    try:
+        await c3.wait_connected(timeout=10)
+        data, _ = await c3.get('/gen1')
+        assert bytes(data) == b'after-recovery'
+        data, _ = await c3.get('/d0')
+        assert bytes(data) == b'gen0-final'
+    finally:
+        await c3.close()
+        leader3.proc.kill()
+        leader3.proc.wait()
+        leader3.proc.stdout.close()
+
+
+@pytest.mark.timeout(120)
+async def test_follower_sigkill_rejoins_from_recovered_zxid(
+        process_ensemble, tmp_path):
+    """A follower with its own mirror WAL is SIGKILLed and respawned
+    over the same dir: it recovers its tree from disk and rejoins
+    with the recovered zxid as the replication catch-up base (tail
+    resync) — then serves the full tree, pre- and post-outage writes
+    included."""
+    leader, (f1, f2) = process_ensemble
+    wal_dir = str(tmp_path / 'follower-wal')
+    fw = _spawn('follower', '127.0.0.1', str(leader.ports[1]),
+                wal_dir)
+    try:
+        c = _client([('127.0.0.1', fw.ports[0])])
+        try:
+            await c.wait_connected(timeout=10)
+            for i in range(6):
+                await c.create('/pre%d' % i, b'p%d' % i)
+            await c.sync('/pre0')
+        finally:
+            await c.close()
+
+        os.kill(fw.proc.pid, signal.SIGKILL)
+        fw.proc.wait()
+        fw.proc.stdout.close()
+
+        # the follower's WAL captured the mirrored history
+        from zkstream_tpu.server.persist import recover_state
+        rec = recover_state(wal_dir)
+        assert rec.zxid >= 6, rec.zxid
+
+        # writes land while it is down (via another member)
+        c2 = _client([('127.0.0.1', f2.ports[0])])
+        try:
+            await c2.wait_connected(timeout=10)
+            for i in range(3):
+                await c2.create('/during%d' % i, b'd%d' % i)
+        finally:
+            await c2.close()
+
+        fw = _spawn('follower', '127.0.0.1', str(leader.ports[1]),
+                    wal_dir)
+        c3 = _client([('127.0.0.1', fw.ports[0])])
+        try:
+            await c3.wait_connected(timeout=10)
+            await c3.sync('/pre0')
+            for i in range(6):
+                data, _ = await c3.get('/pre%d' % i)
+                assert bytes(data) == b'p%d' % i
+            for i in range(3):
+                data, _ = await c3.get('/during%d' % i)
+                assert bytes(data) == b'd%d' % i
+        finally:
+            await c3.close()
+    finally:
+        if fw.proc.poll() is None:
+            fw.proc.kill()
+        fw.proc.wait()
+        if not fw.proc.stdout.closed:
+            fw.proc.stdout.close()
+
+
+@pytest.mark.timeout(120)
 async def test_rolling_sigkill_chaos_soak(process_ensemble):
     """Tier-4 chaos on the process tier: SIGKILL the member serving
     the session, twice in a row (the client's preference order makes

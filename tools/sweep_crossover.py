@@ -275,16 +275,11 @@ def _scrape_ingest(out: dict, ingest, collector) -> None:
 
 
 def _sign_test_p(wins: int, losses: int) -> float:
-    """Two-sided exact sign test (ties dropped): the probability of a
-    split at least this lopsided under H0 = deltas symmetric around 0."""
-    import math
+    """Two-sided exact sign test — shared implementation
+    (zkstream_tpu/utils/metrics.py; bench.py --wal uses it too)."""
+    from zkstream_tpu.utils.metrics import sign_test_p
 
-    n = wins + losses
-    if n == 0:
-        return 1.0
-    k = min(wins, losses)
-    p = 2.0 * sum(math.comb(n, i) for i in range(k + 1)) / (2.0 ** n)
-    return min(1.0, p)
+    return sign_test_p(wins, losses)
 
 
 def run_paired(mode_a: str, mode_b: str, conns: list[int],

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 
 from .client import Client
@@ -295,6 +296,18 @@ def build_parser() -> argparse.ArgumentParser:
     wa.add_argument('--count', '-n', type=int, default=0,
                     help='exit after N events (default: forever)')
 
+    wl = sub.add_parser(
+        'wal',
+        help='dump/verify a write-ahead-log directory '
+             '(server/persist.py): segment listing with CRC32C '
+             'verification, snapshot inventory, truncation point, '
+             'recovery summary — no server, no session')
+    wl.add_argument('dir', help='WAL directory (ZKSTREAM_WAL_DIR / '
+                                'ZKServer(wal_dir=))')
+    wl.add_argument('--records', action='store_true',
+                    help='also list every decoded record '
+                         '(index, zxid, op, path, bytes)')
+
     ch = sub.add_parser(
         'chaos',
         help='run seeded fault-injection schedules against an '
@@ -433,11 +446,84 @@ async def _chaos(args) -> int:
     return 0
 
 
+def _wal(args) -> int:
+    """Dump/verify a WAL directory through the same scan recovery
+    uses (server/persist.py scan_dir), so the CLI and the recovery
+    path can never disagree on what is valid.  Exit 0 when the
+    directory is recoverable (a torn *final* record is the normal
+    crash signature and is tolerated, like recovery tolerates it);
+    exit 1 on structural corruption — a mid-log CRC/decode failure or
+    an invalid snapshot with nothing to fall back to."""
+    from .server.persist import entry_zxid, recover_state, scan_dir
+
+    scan = scan_dir(args.dir)
+    if not scan.segments and not scan.snapshots:
+        print('no WAL state in %s' % (args.dir,), file=sys.stderr)
+        return 1
+    print('wal dir: %s' % (args.dir,))
+    print('segments:')
+    corrupt = 0
+    for i, seg in enumerate(scan.segments):
+        last = i == len(scan.segments) - 1
+        if seg.status == 'ok':
+            note = 'ok'
+        else:
+            note = '%s@%d (%s)' % (seg.status, seg.valid_bytes,
+                                   seg.error)
+            # a torn tail on the FINAL segment is what dying
+            # mid-write leaves; anything else is real corruption
+            if not (last and seg.status in ('torn', 'crc')):
+                corrupt += 1
+        print('  %-28s start=%-6d records=%-5d bytes=%-8d %s'
+              % (os.path.basename(seg.path), seg.start_index,
+                 len(seg.records), seg.size, note))
+        if args.records:
+            for idx, entry in seg.records:
+                extra = ('' if entry[0] != 'create'
+                         else ' data=%dB' % (len(entry[2]),))
+                print('    #%-6d zxid=%-6d %-8s %s%s'
+                      % (idx, entry_zxid(entry), entry[0], entry[1],
+                         extra))
+    print('snapshots:')
+    if not scan.snapshots:
+        print('  (none)')
+    for snap in scan.snapshots:
+        if snap.valid:
+            print('  %-28s index=%-6d zxid=%-6d nodes=%-5d ok'
+                  % (os.path.basename(snap.path), snap.index,
+                     snap.zxid, len(snap.nodes)))
+        else:
+            print('  %-28s INVALID (%s)'
+                  % (os.path.basename(snap.path), snap.error))
+    newest = scan.newest_valid_snapshot()
+    if any(not s.valid for s in scan.snapshots) and newest is None \
+            and scan.snapshots:
+        corrupt += 1
+    if newest is not None:
+        print('truncation point: index %d (zxid %d) — segments '
+              'wholly below the oldest kept snapshot are reclaimable'
+              % (newest.index, newest.zxid))
+    rec = recover_state(args.dir)
+    print('recovery: %s -> zxid %d (next index %d)'
+          % (rec.detail, rec.zxid, rec.last_index))
+    if corrupt:
+        print('status: STRUCTURAL CORRUPTION (%d finding(s)); '
+              'recovery stops at the last valid prefix' % (corrupt,),
+              file=sys.stderr)
+        return 1
+    print('status: clean%s'
+          % (' (torn final record tolerated)' if rec.torn else ''))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == 'chaos':
         # chaos runs its own in-process servers; no --server dial.
         return asyncio.run(_chaos(args))
+    if args.cmd == 'wal':
+        # offline directory inspection: no server, no event loop
+        return _wal(args)
     if args.cmd == 'mntr':
         # raw four-letter-word scrape: no client, no session
         return asyncio.run(_admin(args))

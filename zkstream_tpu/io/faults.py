@@ -56,9 +56,11 @@ from .invariants import AMBIGUOUS_CODES
 #: Decision streams, one seeded RNG each.  'plan' is reserved for the
 #: campaign driver's op/crash scheduling so workload choices never
 #: perturb transport-fault draws; 'ingest' drives the FleetIngest
-#: batched drain's tick-time faults.
+#: batched drain's tick-time faults; 'disk' drives the durability
+#: plane (fsync latency/errors, crash-before-fsync vs crash-after-
+#: fsync windows — server/persist.py).
 CATEGORIES = ('connect', 'rx', 'tx', 'accept', 'server_tx',
-              'partition', 'plan', 'ingest')
+              'partition', 'plan', 'ingest', 'disk')
 
 
 class InjectedRefusal(ConnectionRefusedError):
@@ -94,6 +96,14 @@ class FaultConfig:
     # the device scan) or a connection reset at tick time
     p_ingest_hold: float = 0.0
     p_ingest_reset: float = 0.0
+    # durability plane (server/persist.py): injected fsync latency
+    # (fsync is a blocking syscall; so is its injected delay) and
+    # fsync *errors* — a failed fsync leaves acked writes non-durable
+    # until the next barrier succeeds, which the recovery invariant's
+    # floor demotion accounts for
+    p_fsync_delay: float = 0.0
+    fsync_delay_ms: tuple[float, float] = (0.2, 5.0)
+    p_fsync_error: float = 0.0
     #: stop firing after this many injected faults (None = unbounded);
     #: the budget is what makes randomized campaigns converge
     max_faults: int | None = 8
@@ -117,6 +127,15 @@ class FaultConfig:
         cfg.rx_delay_ms = (0.5, rng.uniform(2.0, 20.0))
         cfg.server_tx_delay_ms = (0.0, rng.uniform(1.0, 8.0))
         cfg.max_faults = rng.randint(1, 5)
+        # disk faults ride their own config stream so adding the
+        # durability plane never perturbed the transport mixes the
+        # existing seeds were tuned on
+        drng = random.Random('cfg-disk/%d' % (seed,))
+        if drng.random() < 0.4:
+            cfg.p_fsync_delay = drng.uniform(0.02, 0.3)
+            cfg.fsync_delay_ms = (0.1, drng.uniform(0.5, 4.0))
+        if drng.random() < 0.15:
+            cfg.p_fsync_error = drng.uniform(0.02, 0.15)
         return cfg
 
     @classmethod
@@ -353,7 +372,7 @@ class FaultInjector:
         """Server-side write hook.  Returns True when the injector took
         over delivery (split/delay/reset), False for pass-through.
 
-        ``pre`` (the connection's send-plane ``flush_now``) runs before
+        ``pre`` (the connection's send-plane ``flush_hard``) runs before
         the injector's first delivery whenever it takes over: frames
         corked in earlier (un-faulted) writes must hit the wire first
         or the stream would reorder in a way TCP never does.  The hook
@@ -440,6 +459,31 @@ class FaultInjector:
                           'ingest tick hold'):
             return 0
         return self._streams['ingest'].randrange(1, nbytes)
+
+    # -- durability plane (server/persist.py) --
+
+    def fsync_fault(self) -> tuple[float, bool]:
+        """One WAL fsync decision point: returns ``(delay_ms, error)``.
+        A delay models a congested device (fsync blocks the loop; so,
+        deliberately, does the injected delay); an error models the
+        fsync failing outright — the WAL counts it and the acked
+        writes under it stay non-durable until the next barrier."""
+        delay = 0.0
+        if self._take('disk', self.config.p_fsync_delay,
+                      'fsync delay'):
+            delay = self._streams['disk'].uniform(
+                *self.config.fsync_delay_ms)
+        err = self._take('disk', self.config.p_fsync_error,
+                         'fsync error')
+        return delay, err
+
+    def crash_window_before_fsync(self) -> bool:
+        """The campaign's SIGKILL placement relative to the pending
+        fsync: True = die before it completes (the open segment's
+        un-fsynced tail is lost), False = die just after.  A plan
+        decision, not a fault — it draws from the 'disk' stream but
+        never spends the fault budget."""
+        return self._streams['disk'].random() < 0.5
 
 
 # ---------------------------------------------------------------------
@@ -540,6 +584,9 @@ async def run_schedule(seed: int, ops: int = 6,
     - no duplicated watch fire: no two dataChanged emits carry the
       same mzxid.
     """
+    import shutil
+    import tempfile
+
     from ..client import Client
     from ..server.server import ZKServer
     from ..server.store import ZKOpError
@@ -547,8 +594,17 @@ async def run_schedule(seed: int, ops: int = 6,
 
     inj = FaultInjector.randomized(seed)
     res = ScheduleResult(seed=seed)
-    srv = await ZKServer().start()
+    # the durability plane rides every schedule: txns are logged to a
+    # throwaway WAL dir and the verification phase recovers a SIGKILL
+    # crash image from it (sync policy drawn per seed; fsync faults
+    # come from the injector's 'disk' category)
+    wal_dir = tempfile.mkdtemp(prefix='zkchaos-wal-')
+    crash_dir = tempfile.mkdtemp(prefix='zkchaos-crash-')
+    durability = 'always' if inj.rand('disk') < 0.25 else 'tick'
+    srv = await ZKServer(wal_dir=wal_dir, durability=durability).start()
     srv.faults = inj
+    if srv.db.wal is not None:          # ZKSTREAM_NO_WAL honored
+        srv.db.wal.faults = inj
     client = Client(
         address='127.0.0.1', port=srv.port, session_timeout=3000,
         seed=seed, faults=inj, op_timeout=CAMPAIGN_OP_DEADLINE_MS,
@@ -641,42 +697,69 @@ async def run_schedule(seed: int, ops: int = 6,
         inj.stop()
         res.faults = len(inj.fired)
 
-        db = srv.db
-        for path, data in created.items():
-            if path in deleted:
-                continue
-            try:
-                got, _stat = db.get_data(path)
-            except ZKOpError:
-                if path in ambig_deleted:
-                    continue    # an unacked delete may have landed
-                res.violations.append(
-                    'acked create %s lost (NO_NODE after campaign)'
-                    % (path,))
-                continue
-            if path != '/w' and bytes(got) != data:
-                res.violations.append(
-                    'acked create %s holds %r, expected %r'
-                    % (path, bytes(got), data))
-        for path in deleted:
-            try:
-                db.get_data(path)
-                res.violations.append(
-                    'acked delete %s did not stick' % (path,))
-            except ZKOpError:
-                pass
-        if last_acked_set >= 0:
-            try:
-                got, _stat = db.get_data('/w')
-                idx = int(bytes(got)[1:])
-                if idx < last_acked_set:
-                    res.violations.append(
-                        'acked set v%d lost: /w holds %r'
-                        % (last_acked_set, bytes(got)))
-            except (ZKOpError, ValueError):
-                res.violations.append(
-                    'acked set v%d lost: /w unreadable'
-                    % (last_acked_set,))
+        def check_acked_tree(db, prefix=''):
+            vs = []
+            for path, data in created.items():
+                if path in deleted:
+                    continue
+                try:
+                    got, _stat = db.get_data(path)
+                except ZKOpError:
+                    if path in ambig_deleted:
+                        continue  # an unacked delete may have landed
+                    vs.append(
+                        '%sacked create %s lost (NO_NODE after '
+                        'campaign)' % (prefix, path))
+                    continue
+                if path != '/w' and bytes(got) != data:
+                    vs.append(
+                        '%sacked create %s holds %r, expected %r'
+                        % (prefix, path, bytes(got), data))
+            for path in deleted:
+                try:
+                    db.get_data(path)
+                    vs.append('%sacked delete %s did not stick'
+                              % (prefix, path))
+                except ZKOpError:
+                    pass
+            if last_acked_set >= 0:
+                try:
+                    got, _stat = db.get_data('/w')
+                    idx = int(bytes(got)[1:])
+                    if idx < last_acked_set:
+                        vs.append('%sacked set v%d lost: /w holds %r'
+                                  % (prefix, last_acked_set,
+                                     bytes(got)))
+                except (ZKOpError, ValueError):
+                    vs.append('%sacked set v%d lost: /w unreadable'
+                              % (prefix, last_acked_set))
+            return vs
+
+        res.violations.extend(check_acked_tree(srv.db))
+
+        # -- durability: SIGKILL crash image + restart-from-disk ----
+        # (invariant 6 — io/invariants.py).  The crash window is
+        # injector-chosen: before the pending fsync (the open
+        # segment's un-fsynced tail dies with the page cache) or just
+        # after.  Acks under sync='always'/'tick' are fsynced before
+        # they leave (the send-plane barrier), so the recovered tree
+        # must hold every acked write regardless of the window —
+        # except past fsync *errors*, whose acks the transport tier
+        # cannot zxid-correlate (no per-ack zxids here; the ensemble
+        # tier's history can, and does, via the floor demotion).
+        wal = srv.db.wal
+        if wal is not None and not wal.sync_errors:
+            from ..server.persist import recover_state
+            from ..server.store import NodeTree
+
+            before = inj.crash_window_before_fsync()
+            wal.materialize_crash(crash_dir, before_fsync=before)
+            rec = recover_state(crash_dir, trace=client.trace)
+            rtree = NodeTree()
+            rtree.install({'zxid': rec.zxid, 'nodes': rec.nodes})
+            res.violations.extend(check_acked_tree(
+                rtree, prefix='durability (crash %s fsync): '
+                % ('before' if before else 'after')))
 
         res.watch_fires = len(fires)
         dupes = [z for z in set(fires) if fires.count(z) > 1]
@@ -691,6 +774,10 @@ async def run_schedule(seed: int, ops: int = 6,
             client.pool.stop()
             res.violations.append('client.close() hung past 5s')
         await srv.stop()
+        if srv.db.wal is not None:
+            srv.db.wal.close()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+        shutil.rmtree(crash_dir, ignore_errors=True)
         inj.close()
         # dump after teardown so close-phase errors are captured too
         res.trace = client.trace.dump()
@@ -755,11 +842,17 @@ class FaultPlan:
     #: values force live session migration back toward the leader
     #: mid-schedule
     decoherence_ms: int | None = None
+    #: WAL fsync policy for the schedule ('always' | 'tick'; 'never'
+    #: forfeits the guarantee the campaign exists to check, so it
+    #: stays a bench arm) — server/persist.py
+    durability: str = 'tick'
+    #: small segments force rotation + fuzzy snapshots mid-schedule
+    wal_segment_bytes: int = 1 << 16
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
         rng = random.Random('plan/%d' % (seed,))
-        return cls(
+        plan = cls(
             seed=seed,
             config=FaultConfig.randomized_ensemble(seed),
             ops=ops,
@@ -767,6 +860,12 @@ class FaultPlan:
             ingest_mode=rng.choice(['none', 'none', 'direct',
                                     'batch']),
             decoherence_ms=rng.choice([None, None, 50, 120]))
+        # drawn AFTER the existing fields so the durability plane
+        # never perturbed the plan shapes the existing seeds produce
+        plan.durability = rng.choice(['tick', 'tick', 'always'])
+        plan.wal_segment_bytes = rng.choice([1 << 12, 1 << 14,
+                                             1 << 20])
+        return plan
 
 
 class EnsembleUnderTest:
@@ -789,11 +888,15 @@ class EnsembleUnderTest:
     is the partition target, and its convergence with the leader after
     heal + sync barrier is one of the campaign's checks."""
 
-    def __init__(self, members: int = 3):
+    def __init__(self, members: int = 3, wal_dir: str | None = None,
+                 durability: str | None = None,
+                 wal_segment_bytes: int | None = None):
         from ..server.replication import ReplicationService
         from ..server.server import ZKEnsemble
 
-        self._ens = ZKEnsemble(members, lag=0.0)
+        self._ens = ZKEnsemble(members, lag=0.0, wal_dir=wal_dir,
+                               durability=durability,
+                               wal_segment_bytes=wal_segment_bytes)
         self.db = self._ens.db
         self.servers = self._ens.servers
         self.svc = ReplicationService(self.db)
@@ -817,6 +920,8 @@ class EnsembleUnderTest:
     def install_faults(self, inj: FaultInjector) -> None:
         self._ens.install_faults(inj)
         self.svc.faults = inj
+        if self.db.wal is not None:
+            self.db.wal.faults = inj
 
     def addresses(self) -> list[tuple[str, int]]:
         return self._ens.addresses()
@@ -877,13 +982,20 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     from .invariants import History, check_ephemerals, check_history
     from .pool import DEFAULT_DECOHERENCE_INTERVAL
 
+    import shutil
+    import tempfile
+
     if plan is None:
         plan = FaultPlan.randomized(seed, ops=ops)
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble')
     h = History()
 
-    ens = await EnsembleUnderTest(plan.members).start()
+    wal_dir = tempfile.mkdtemp(prefix='zkchaos-ens-wal-')
+    crash_dir = tempfile.mkdtemp(prefix='zkchaos-ens-crash-')
+    ens = await EnsembleUnderTest(
+        plan.members, wal_dir=wal_dir, durability=plan.durability,
+        wal_segment_bytes=plan.wal_segment_bytes).start()
     ens.install_faults(inj)
 
     ingest = None
@@ -929,6 +1041,16 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 return r['session_id']
         return 0
 
+    def last_zxid() -> int | None:
+        """The reply zxid of the op that just completed (its span
+        settles — and lands in the history via on_op — before the op
+        future resolves); stamps acks so the recovery invariant can
+        demote acks past a failed fsync's durable floor."""
+        for r in reversed(h.records):
+            if r['kind'] == 'op':
+                return r.get('zxid')
+        return None
+
     async def bounded(coro, what, op=None, path=None, seq_parent=None):
         """One op under the shared hard bound (_bounded_op); writes
         with an unknown outcome are recorded as ambiguous."""
@@ -949,7 +1071,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             h.acked_create(made, data, sid(),
                            ephemeral=bool(CreateFlag(flags)
                                           & CreateFlag.EPHEMERAL),
-                           sequential_parent=seq_parent)
+                           sequential_parent=seq_parent,
+                           zxid=last_zxid())
         return ok, made
 
     async def wait_usable(timeout: float) -> bool:
@@ -986,7 +1109,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         # fine — the dependent ops surface typed errors
         ok, _ = await do_create('/w', b'v0')
         if ok:
-            h.acked_set('/w', 0, sid())
+            h.acked_set('/w', 0, sid(), zxid=last_zxid())
         await do_create('/seq', b'')
 
         for i in range(plan.ops):
@@ -1000,7 +1123,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                     'set /w v%d' % set_idx, op='set', path='/w')
                 if ok:
                     res.acked += 1
-                    h.acked_set('/w', set_idx, sid())
+                    h.acked_set('/w', set_idx, sid(),
+                                zxid=last_zxid())
             elif act == 'create':
                 ok, made = await do_create('/c%d' % i, b'd%d' % i)
                 if ok:
@@ -1021,7 +1145,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                                       op='delete', path=path)
                 if ok:
                     res.acked += 1
-                    h.acked_delete(path, sid())
+                    h.acked_delete(path, sid(), zxid=last_zxid())
                     created.remove(path)
             elif act == 'get':
                 await bounded(client.get('/w'), 'get /w')
@@ -1053,7 +1177,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                         op='set', path='/w')
                     if ok:
                         res.acked += 1
-                        h.acked_set('/w', set_idx, sid())
+                        h.acked_set('/w', set_idx, sid(),
+                                    zxid=last_zxid())
                 else:
                     note_member('kill', victim)
                     await ens.kill(victim)
@@ -1138,6 +1263,33 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
 
         res.watch_fires = len(fires)
         res.violations.extend(check_history(h, ens.db))
+
+        # -- durability: full-ensemble SIGKILL + restart-from-disk --
+        # (invariant 6).  The crash image is the WAL directory as a
+        # SIGKILL would leave it — cut at an injector-chosen fsync
+        # window — and the recovered database must hold every
+        # unambiguously-acked write.  The floor demotion only engages
+        # when an injected fsync error left acks non-durable; under
+        # the clean sync barrier every ack is enforced.
+        wal = ens.db.wal
+        if wal is not None:
+            from ..server.persist import recover_state
+            from ..server.store import ZKDatabase
+            from .invariants import check_durable_recovery
+
+            before = inj.crash_window_before_fsync()
+            floor = wal.materialize_crash(crash_dir,
+                                          before_fsync=before)
+            h.member_event(
+                'sigkill-recover(%s-fsync)'
+                % ('before' if before else 'after'), 'ensemble')
+            rec = recover_state(crash_dir, trace=client.trace)
+            rdb = ZKDatabase()
+            rdb.nodes = rec.nodes
+            rdb.zxid = rec.zxid
+            res.violations.extend(check_durable_recovery(
+                h, rdb,
+                floor_zxid=floor if wal.sync_errors else None))
         return res
     finally:
         # stop injecting on every exit path (the never-connected early
@@ -1173,6 +1325,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         inj.close()
         if ingest is not None:
             ingest.close()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+        shutil.rmtree(crash_dir, ignore_errors=True)
         res.trace = client.trace.dump()
         res.history = list(h.records)
         # derived, never dual-appended: the history's member records

@@ -41,6 +41,13 @@ failing seed's report reads without the source):
    for the same change: per (path, kind) no duplicated zxid, and at
    most one 'deleted' per single-deletion path (re-arms over the same
    absence stay silent).
+6. **Durable recovery** (:func:`check_durable_recovery`, the
+   durability plane's invariant) — after a full-ensemble SIGKILL, the
+   database recovered from the write-ahead log (server/persist.py:
+   newest valid snapshot + replayed tail, torn final record
+   tolerated) holds every unambiguously-acked write, with the same
+   ambiguity rules as invariant 1.  Both chaos tiers run it against a
+   crash image cut at an injector-chosen fsync window.
 
 The history is plain data (a list of dicts) so it can ride a JSON
 trace dump next to the span ring; :func:`format_history` renders the
@@ -83,19 +90,21 @@ class History:
 
     def acked_create(self, path: str, data: bytes, session_id: int,
                      ephemeral: bool = False,
-                     sequential_parent: str | None = None) -> dict:
+                     sequential_parent: str | None = None,
+                     zxid: int | None = None) -> dict:
         return self._add('ack', op='create', path=path, data=data,
                          session_id=session_id, ephemeral=ephemeral,
-                         seq_parent=sequential_parent)
+                         seq_parent=sequential_parent, zxid=zxid)
 
-    def acked_delete(self, path: str, session_id: int) -> dict:
+    def acked_delete(self, path: str, session_id: int,
+                     zxid: int | None = None) -> dict:
         return self._add('ack', op='delete', path=path,
-                         session_id=session_id)
+                         session_id=session_id, zxid=zxid)
 
     def acked_set(self, path: str, index: int,
-                  session_id: int) -> dict:
+                  session_id: int, zxid: int | None = None) -> dict:
         return self._add('ack', op='set', path=path, index=index,
-                         session_id=session_id)
+                         session_id=session_id, zxid=zxid)
 
     def ambiguous(self, op: str, path: str | None,
                   session_id: int = 0,
@@ -133,9 +142,16 @@ class History:
 # ---------------------------------------------------------------------
 
 
-def check_acked_durability(history: History, db) -> list[str]:
+def check_acked_durability(history: History, db,
+                           floor_zxid: int | None = None) -> list[str]:
     """Invariant 1: no acked write lost.  ``db`` is the leader
-    ZKDatabase (reads bypass the wire; faults are stopped)."""
+    ZKDatabase (reads bypass the wire; faults are stopped).
+
+    ``floor_zxid`` (recovery checks, :func:`check_durable_recovery`):
+    acks sequenced past the newest *known-durable* zxid — possible
+    only when an fsync failed under them — are demoted to their
+    outcome-unknown form instead of enforced; ``None`` enforces every
+    ack."""
     from ..server.store import ZKOpError
 
     out: list[str] = []
@@ -150,6 +166,16 @@ def check_acked_durability(history: History, db) -> list[str]:
     last_set: dict[str, int] = {}
     for r in history.records:
         if r['kind'] == 'ack':
+            if floor_zxid is not None and (
+                    r.get('zxid') is None or r['zxid'] > floor_zxid):
+                # past the durable floor: this ack's txn may not have
+                # reached disk before the crash — demote, do not
+                # enforce (it may legitimately be present OR absent)
+                if r['op'] == 'create' and r.get('path'):
+                    ambig_create.add(r['path'])
+                elif r['op'] == 'delete':
+                    ambig_delete.add(r['path'])
+                continue
             if r['op'] == 'create':
                 created[r['path']] = r
                 deleted.pop(r['path'], None)
@@ -209,6 +235,34 @@ def check_acked_durability(history: History, db) -> list[str]:
         if have < idx:
             out.append('acked set v%d on %s lost: final value %r'
                        % (idx, path, bytes(got)))
+    return out
+
+
+def check_durable_recovery(history: History, db,
+                           floor_zxid: int | None = None) -> list[str]:
+    """Invariant 6 (the durability plane, server/persist.py): after a
+    full-ensemble SIGKILL, a database recovered from the newest valid
+    snapshot plus the replayed WAL tail still holds every
+    unambiguously-acked write.  ``db`` is the *recovered* tree (not
+    the live leader's); the ambiguity rules are exactly invariant 1's
+    — an outcome-unknown write may or may not have reached the log —
+    plus the ``floor_zxid`` demotion for acks an fsync error left
+    non-durable (``None`` = every ack was fsynced before it left,
+    the sync='always'/'tick' barrier contract).  Ephemeral absence is
+    excused as in invariant 1: a full crash kills every session, so
+    recovery reaps them by logged deletes."""
+    out = ['durability: %s' % v
+           for v in check_acked_durability(history, db,
+                                           floor_zxid=floor_zxid)]
+    top = 0
+    for r in history.of_kind('ack'):
+        z = r.get('zxid')
+        if z and (floor_zxid is None or z <= floor_zxid):
+            top = max(top, z)
+    if db.zxid < top:
+        out.append('durability: recovered zxid %d is behind the '
+                   'newest durable acked zxid %d (log tail lost)'
+                   % (db.zxid, top))
     return out
 
 

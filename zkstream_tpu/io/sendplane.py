@@ -15,9 +15,13 @@ per-tick cork, not the kernel's implicit per-RTT one.
 
 Ordering contract: every byte a connection sends goes through its
 plane in call order — either corked (``send``) or after an explicit
-``flush_now`` for paths that must hit the wire mid-tick (fault
+``flush_hard`` for paths that must hit the wire mid-tick (fault
 injection delivering a truncated frame before its scheduled reset,
 CLOSE_SESSION ahead of ``write_eof``, a server connection closing).
+Server planes may additionally carry a durability barrier: corked
+acks wait (still corked, still ordered) for the WAL's off-loop group
+fsync before they reach the transport — see ``barrier`` below and
+server/persist.py.
 The fault injector's tx hooks stay a per-frame boundary: injection
 happens *before* the cork, and an injected delivery pre-flushes the
 plane so the faulted frame cannot reorder ahead of earlier corked
@@ -69,12 +73,26 @@ class SendPlane:
 
     __slots__ = ('_write', '_chunks', '_pending', '_scheduled',
                  'enabled', 'max_bytes', '_frames_hist', '_bytes_hist',
-                 '_labels')
+                 '_labels', '_barrier')
 
     def __init__(self, write, *, enabled: bool | None = None,
                  max_bytes: int = DEFAULT_MAX_CORK,
-                 collector=None, plane: str = 'client'):
+                 collector=None, plane: str = 'client',
+                 barrier=None):
         self._write = write
+        #: Optional durability barrier gating corked bytes
+        #: (server/persist.py WriteAheadLog): the acks of one tick
+        #: share one group fsync, and no ack byte reaches the sink
+        #: before its txn is on disk.  ``barrier.gate_flush(release)``
+        #: returns True when everything appended is already durable;
+        #: otherwise the flush stays corked, a group fsync runs on an
+        #: executor thread (the loop keeps serving), and ``release``
+        #: re-flushes when durability catches up.  Paths that must
+        #: hit the wire mid-tick use :meth:`flush_hard`, which takes
+        #: the barrier synchronously instead.  With the cork disabled
+        #: frames still flow through the gate one by one — stricter,
+        #: never weaker.
+        self._barrier = barrier
         self._chunks: list[bytes] = []
         self._pending = 0
         self._scheduled = False
@@ -102,8 +120,15 @@ class SendPlane:
         """Append one encoded frame; it reaches the sink at the next
         tick flush (or immediately: cork disabled / size cap hit)."""
         if not self.enabled:
-            self._observe(1, len(data))
-            self._write(data)
+            if self._barrier is None:
+                self._observe(1, len(data))
+                self._write(data)
+                return
+            # write-through still rides the gate: the frame corks for
+            # exactly one (usually immediate) gated flush
+            self._chunks.append(data)
+            self._pending += len(data)
+            self.flush_now()
             return
         self._chunks.append(data)
         self._pending += len(data)
@@ -119,10 +144,28 @@ class SendPlane:
         self.flush_now()
 
     def flush_now(self) -> None:
-        """Write everything corked, in order, as one buffer.  Safe to
-        call any time (idle flush is a no-op); paths that must hit the
-        wire mid-tick (fault delivery, EOF, close) call this first so
-        the stream cannot reorder."""
+        """Write everything corked, in order, as one buffer — once the
+        durability barrier (if any) clears.  A gated flush keeps the
+        frames corked while the group fsync runs off-loop and re-runs
+        when it completes, so the stream order never changes; callers
+        that need the bytes on the wire before they return use
+        :meth:`flush_hard`."""
+        if not self._chunks:
+            return
+        if self._barrier is not None and \
+                not self._barrier.gate_flush(self.flush_now):
+            return              # durability pending: released later
+        self._write_out()
+
+    def flush_hard(self) -> None:
+        """Barrier taken synchronously, bytes written before return —
+        for paths where later writes must not overtake (fault-injected
+        delivery, CLOSE_SESSION ahead of EOF, connection close)."""
+        if self._barrier is not None:
+            self._barrier.sync_for_flush()
+        self._write_out()
+
+    def _write_out(self) -> None:
         if not self._chunks:
             return
         chunks = self._chunks

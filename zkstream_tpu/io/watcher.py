@@ -267,13 +267,20 @@ class ZKWatchEvent(FSM):
                 zxid = pkt['stat'].pzxid
             else:
                 raise ValueError('Unknown watcher event %s' % (self.evt,))
-            # Emit only if the relevant zxid moved since the last emit:
-            # this suppresses duplicate notifications from the server
-            # watch-kind overlap (reference: lib/zk-session.js:849-856).
+            # Emit only if the relevant zxid moved FORWARD since the
+            # last emit: equality suppresses duplicate notifications
+            # from the server watch-kind overlap (reference:
+            # lib/zk-session.js:849-856), and an OLDER zxid is a
+            # stale read — a churn-forced re-arm can land on a
+            # lagging follower that has not applied a change this
+            # watcher already delivered, and re-emitting the old
+            # state would be a duplicate fire for a change the
+            # watcher saw (the at-most-once invariant,
+            # io/invariants.py check_watch_once).
             self._arm_ok()
             self._observe_rearm(arm_t0)
             self._deleted_seen = False
-            if self.prev_zxid is not None and zxid == self.prev_zxid:
+            if self.prev_zxid is not None and zxid <= self.prev_zxid:
                 S.goto_state('armed')
                 return
             EventEmitter.emit(self.emitter, *args)
@@ -346,10 +353,13 @@ class ZKWatchEvent(FSM):
                 zxid = pkt['stat'].pzxid
             else:
                 raise ValueError('Unknown watcher event %s' % (self.evt,))
-            if self.prev_zxid is None or zxid != self.prev_zxid:
+            if self.prev_zxid is None or zxid > self.prev_zxid:
                 # Crash-on-bug (see ZKWatcher.notify): fatal by
                 # default, never a swallowed callback exception
                 # (reference throws: lib/zk-session.js:916-919).
+                # Only a zxid AHEAD of the last emit is a missed
+                # wakeup; an older one is a stale read from a
+                # lagging member (the next probe re-checks).
                 self.session.fatal_error(LostWakeupError(
                     'ZKWatchEvent double-check failed: a ZK event '
                     'wakeup was missed, this is a bug'))

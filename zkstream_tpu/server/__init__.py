@@ -3,6 +3,13 @@ simulation, and cross-process member replication (the rebuild's
 replacement for the reference's JVM-spawning test harness,
 test/zkserver.js)."""
 
+from .persist import (  # noqa: F401
+    WriteAheadLog,
+    attach_wal,
+    open_wal_database,
+    recover_state,
+    scan_dir,
+)
 from .replication import (  # noqa: F401
     RemoteLeader,
     RemoteReplicaStore,

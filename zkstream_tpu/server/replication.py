@@ -216,6 +216,10 @@ class ReplicationService:
             writer.close()
             return
         kind, token = hello[0], hello[1]
+        # a follower that recovered its tree from its own WAL
+        # (server/persist.py) announces the zxid it holds; None for
+        # fresh joiners and pre-durability hellos
+        have_zxid = hello[2] if len(hello) > 2 else None
         if kind == 'events':
             h = self._handles.get(token)
             if h is None:
@@ -225,17 +229,35 @@ class ReplicationService:
                     self.db.attach_replica(h)
                 except ValueError:
                     # a late joiner (a follower restarted — or first
-                    # started — after history began): bootstrap it
-                    # from a snapshot, real ZK's follower resync.  The
-                    # log before replication began was never retained;
-                    # the tree image carries its effects.
-                    pos = self.db.attach_replica_at_tail(h)
-                    h.applied = h.shipped = pos
-                    self._push(h, ('snapshot', self.db.snapshot(),
-                                   pos))
-                    log.info('follower %s joined late: snapshot at '
-                             'log index %d (zxid %d)', token, pos,
-                             self.db.zxid)
+                    # started — after history began).  A follower that
+                    # recovered from disk rejoins with its recovered
+                    # zxid as the catch-up base when the retained log
+                    # still covers it — shipped only the tail, no
+                    # image; otherwise (and for fresh joiners) it is
+                    # bootstrapped from a snapshot, real ZK's follower
+                    # resync.  The log before replication began was
+                    # never retained; the tree image carries its
+                    # effects.
+                    pos = None
+                    if have_zxid is not None:
+                        pos = self.db.attach_replica_resync(
+                            h, have_zxid)
+                        if pos is not None:
+                            h.applied = h.shipped = pos
+                            self._push(h, ('resync', pos))
+                            log.info(
+                                'follower %s rejoined by WAL resync '
+                                'at log index %d (recovered zxid %d, '
+                                'leader zxid %d)', token, pos,
+                                have_zxid, self.db.zxid)
+                    if pos is None:
+                        pos = self.db.attach_replica_at_tail(h)
+                        h.applied = h.shipped = pos
+                        self._push(h, ('snapshot', self.db.snapshot(),
+                                       pos))
+                        log.info('follower %s joined late: snapshot '
+                                 'at log index %d (zxid %d)', token,
+                                 pos, self.db.zxid)
                 self._handles[token] = h
             else:
                 h.writer = writer
@@ -286,6 +308,10 @@ class ReplicationService:
                 assert op == 'rpc', op
                 _, seq, method, args, have = msg
                 status, payload = self._dispatch(method, args)
+                if db.wal is not None:
+                    # logged-before-ack across processes too: a
+                    # forwarded write's RPC response is its ack
+                    db.wal.sync_for_flush()
                 base, entries = self._entries_from(have)
                 writer.write(_dump(
                     ('res', seq, status, payload, base, entries)))
@@ -337,20 +363,33 @@ class RemoteLeader(EventEmitter):
     Emits ``committed`` (mirror grew) and ``sessionExpired(sid)`` —
     the two ``ZKDatabase`` events the server stack subscribes to."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 have_zxid: int | None = None):
         super().__init__()
         self.host = host
         self.port = port
         import uuid
         self._token = uuid.uuid4().hex
+        #: the zxid this follower recovered from its own WAL
+        #: (server/persist.py), announced in the events hello so the
+        #: leader can ship only the tail instead of a snapshot
+        self.have_zxid = have_zxid
         #: the commit-log mirror (never truncated: one local replica)
         self.log: list = []
         self.log_base = 0
         self.sessions: dict[int, ZKServerSession] = {}
+        #: optional mirror write-ahead log: every entry that lands in
+        #: the mirror is appended (durability for the follower's own
+        #: restart; the worker wires this, tests/process_member_worker)
+        self.wal = None
         #: set when the leader bootstrapped this (late-joining)
         #: follower from a snapshot: (image, absolute log index) that
         #: RemoteReplicaStore installs before replaying the tail
         self._snapshot: tuple[dict, int] | None = None
+        #: set when the leader accepted ``have_zxid`` as the catch-up
+        #: base ('resync'): the recovered tree stands, only the tail
+        #: is shipped
+        self.resynced = False
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         #: serializes mirror growth: in the follower process both
@@ -391,7 +430,7 @@ class RemoteLeader(EventEmitter):
         self._sock.sendall(_dump(('control', self._token)))
         reader, writer = await asyncio.open_connection(
             self.host, self.port)
-        writer.write(_dump(('events', self._token)))
+        writer.write(_dump(('events', self._token, self.have_zxid)))
         await writer.drain()
         self._events_writer = writer
         self._attached = asyncio.get_running_loop().create_future()
@@ -437,6 +476,14 @@ class RemoteLeader(EventEmitter):
                         assert not self.log, 'snapshot after entries'
                         self._snapshot = (msg[1], msg[2])
                         self.log_base = msg[2]
+                elif msg[0] == 'resync':
+                    # the leader accepted have_zxid as the catch-up
+                    # base: no image — the recovered tree stands and
+                    # the mirror starts at the leader's matching index
+                    with self._mirror_lock:
+                        assert not self.log, 'resync after entries'
+                        self.resynced = True
+                        self.log_base = msg[1]
                 elif msg[0] == 'attached':
                     if not self._attached.done():
                         self._attached.set_result(True)
@@ -458,6 +505,14 @@ class RemoteLeader(EventEmitter):
             tail = entries[end - base:]
             if tail:
                 self.log.extend(tail)
+                if self.wal is not None:
+                    # mirror durability: the follower's own WAL logs
+                    # what it has mirrored, so a SIGKILLed follower
+                    # restarts from disk and rejoins with have_zxid
+                    # (in the worker both channels share one loop, so
+                    # appends are loop-serialized like the leader's)
+                    for e in tail:
+                        self.wal.append(e)
             acked = self.log_end()
         if tail and self._events_writer is not None:
             # the ack rides the events transport, which belongs to the
@@ -566,13 +621,20 @@ class RemoteReplicaStore(ReplicaStore):
       the write, and a second blocking round-trip per write would
       stall the member's whole event loop."""
 
-    def __init__(self, leader: RemoteLeader, lag: float | None = 0.0):
+    def __init__(self, leader: RemoteLeader, lag: float | None = 0.0,
+                 recovered: dict | None = None):
         super().__init__(leader, lag=lag)
         if leader._snapshot is not None:
             snap, pos = leader._snapshot
             leader._snapshot = None     # release the image: installed
             self.install(snap)          # state must not be pinned (or
             self.applied = pos          # re-installed) afterwards
+        elif recovered is not None and leader.resynced:
+            # restart-from-disk: the tree recovered from this
+            # follower's own WAL is the catch-up base — the leader
+            # shipped no image, only the tail past recovered['zxid']
+            self.install(recovered)
+            self.applied = leader.log_base
         if self.lag is not None and self.lag <= 0:
             # entries can land in the mirror between the snapshot (or
             # plain attach) and this construction; _on_commit only

@@ -66,8 +66,14 @@ class ServerConnection:
         #: Outbound cork (io/sendplane.py): replies and notifications
         #: of one event-loop tick leave as a single writer.write (a
         #: pipelined request batch is answered with one segment).
+        #: When the leader database carries a WAL, the plane gates on
+        #: it: corked acks wait (in order) for the off-loop group
+        #: fsync covering their txns, so no ack byte reaches the
+        #: transport before its txn is on disk and the event loop
+        #: never blocks on the device (server/persist.py sync='tick').
         self._tx = SendPlane(self._tx_write, enabled=server.cork,
-                             collector=server.collector, plane='server')
+                             collector=server.collector, plane='server',
+                             barrier=getattr(server.db, 'wal', None))
 
     # -- wire helpers --
 
@@ -82,7 +88,7 @@ class ServerConnection:
             return
         fi = self.server.faults
         if fi is not None and fi.server_tx(self, data,
-                                           pre=self._tx.flush_now):
+                                           pre=self._tx.flush_hard):
             return   # the injector took over delivery (split/delay/RST)
         self._tx.send(data)
 
@@ -248,8 +254,9 @@ class ServerConnection:
     def close(self) -> None:
         if self.closed:
             return
-        # corked replies (e.g. the CLOSE_SESSION ack) must beat the FIN
-        self._tx.flush_now()
+        # corked replies (e.g. the CLOSE_SESSION ack) must beat the
+        # FIN — and their durability barrier, taken synchronously
+        self._tx.flush_hard()
         self.closed = True
         self._unsubscribe()
         if self.session is not None and self.session.owner is self:
@@ -433,8 +440,33 @@ class ZKServer:
     def __init__(self, db: ZKDatabase | None = None,
                  host: str = '127.0.0.1', port: int = 0,
                  store=None, cork: bool | None = None,
-                 collector=None):
-        self.db = db if db is not None else ZKDatabase()
+                 collector=None, durability: str | None = None,
+                 wal_dir: str | None = None):
+        #: Durability plane (server/persist.py).  When this server
+        #: owns its database (``db=None``) and a WAL directory is
+        #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
+        #: — the database is recovered from disk and every committed
+        #: txn is logged before its ack; ``durability`` picks the
+        #: fsync policy ('always' | 'tick' | 'never', default 'tick').
+        #: ``ZKSTREAM_NO_WAL=1`` is the global kill switch.  An
+        #: ensemble attaches its WAL once on the shared database
+        #: instead (ZKEnsemble); followers carry none.
+        self._owns_wal = False
+        if db is None:
+            from .persist import (
+                default_wal_dir,
+                open_wal_database,
+                wal_enabled,
+            )
+            resolved = wal_dir or default_wal_dir()
+            if resolved and wal_enabled():
+                db = open_wal_database(resolved,
+                                       sync=durability or 'tick',
+                                       collector=collector)
+                self._owns_wal = True
+            else:
+                db = ZKDatabase()
+        self.db = db
         self.store = store if store is not None else self.db
         self.host = host
         self.port = port
@@ -496,7 +528,10 @@ class ZKServer:
     async def stop(self) -> None:
         """Kill the server: stop listening and sever every connection.
         Sessions live in the database and keep their expiry clocks
-        running — exactly what a crashed ensemble member looks like."""
+        running — exactly what a crashed ensemble member looks like.
+        A WAL this server opened itself is closed (final fsync, fd
+        released) — ``restart`` reopens it; an ensemble's shared WAL
+        belongs to the ensemble (ZKEnsemble.stop)."""
         for conn in list(self.conns):
             conn.close()
         self.conns.clear()
@@ -506,12 +541,27 @@ class ZKServer:
             # handlers to return, so connections must be severed first.
             await self._server.wait_closed()
             self._server = None
+        if self._owns_wal and not self.db.wal.closed:
+            self.db.wal.close()
 
-    async def restart(self) -> 'ZKServer':
+    async def restart(self, from_disk: bool = False) -> 'ZKServer':
         """Bring a killed member back on its old port; a rejoining
         member first applies everything the leader committed while it
-        was down, like a real follower resync."""
+        was down, like a real follower resync.
+
+        ``from_disk=True`` models the harsher death: the process (not
+        just the listener) died, so RAM is gone and the member comes
+        back from its write-ahead log — newest valid snapshot plus
+        the replayed tail (server/persist.py).  Standalone/leader
+        only; it requires a WAL and drops every session, exactly like
+        a real restart."""
         assert self._server is None, 'server still running'
+        if from_disk:
+            assert self.store is self.db, \
+                'restart-from-disk rebuilds the leader database'
+            self.db.recover_from_disk()
+        elif self.db.wal is not None and self.db.wal.closed:
+            self.db.wal.reopen()     # stop() closed it with the member
         self.store.catch_up()
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port)
@@ -538,6 +588,14 @@ class ZKServer:
                          for s in self.db.sessions.values())
         data_size = sum(len(n.data)
                         for n in self.store.nodes.values())
+        wal = getattr(self.db, 'wal', None)
+        wal_rows = [] if wal is None else [
+            ('zk_wal_sync', wal.sync),
+            ('zk_wal_last_index', wal.next_index),
+            ('zk_wal_fsyncs', wal.fsyncs),
+            ('zk_wal_sync_errors', wal.sync_errors),
+            ('zk_wal_snapshots', wal.snapshots_taken),
+        ]
         return [
             ('zk_version', 'zkstream_tpu'),
             ('zk_server_state', self.mode()),
@@ -551,7 +609,7 @@ class ZKServer:
             ('zk_approximate_data_size', data_size),
             ('zk_sessions', len(self.db.sessions)),
             ('zk_zxid', '0x%x' % (self.store.zxid,)),
-        ]
+        ] + wal_rows
 
     def admin_text(self, word: str) -> str:
         """Render one four-letter word's reply text."""
@@ -596,8 +654,28 @@ class ZKEnsemble:
     meaning (tests/test_multi_node.py drives both regimes)."""
 
     def __init__(self, count: int = 3, host: str = '127.0.0.1',
-                 lag: float | None = 0.0):
-        self.db = ZKDatabase()
+                 lag: float | None = 0.0,
+                 wal_dir: str | None = None,
+                 durability: str | None = None,
+                 collector=None, wal_segment_bytes: int | None = None):
+        #: One WAL for the whole ensemble, attached to the shared
+        #: leader database (followers hold replica views of the same
+        #: history; a per-member log would just write it N times).
+        #: With a wal_dir the ensemble RECOVERS from it — a fresh
+        #: ZKEnsemble over yesterday's directory is restart-from-disk.
+        if wal_dir:
+            from .persist import open_wal_database, wal_enabled
+            if wal_enabled():
+                kw = {}
+                if wal_segment_bytes is not None:
+                    kw['segment_bytes'] = wal_segment_bytes
+                self.db = open_wal_database(
+                    wal_dir, sync=durability or 'tick',
+                    collector=collector, **kw)
+            else:
+                self.db = ZKDatabase()
+        else:
+            self.db = ZKDatabase()
         self.servers = [
             ZKServer(self.db, host=host,
                      store=None if i == 0 else ReplicaStore(self.db,
@@ -624,8 +702,13 @@ class ZKEnsemble:
         return self
 
     async def stop(self) -> None:
+        """Full-ensemble death: every member stops and the WAL (when
+        configured) is closed — a fresh ZKEnsemble over the same
+        ``wal_dir`` is the restart-from-disk path."""
         for s in self.servers:
             await s.stop()
+        if self.db.wal is not None:
+            self.db.wal.close()
 
     async def kill(self, idx: int) -> None:
         await self.servers[idx].stop()

@@ -139,11 +139,30 @@ class NodeTree(EventEmitter):
     def install(self, snap: dict) -> None:
         """Replace this tree with a snapshot image.  The image is
         adopted, not copied — it arrives freshly unpickled from the
-        replication socket and is private to this replica."""
+        replication socket (or a WAL snapshot file, server/persist.py)
+        and is private to this replica."""
         self.nodes = snap['nodes']
         self.zxid = snap['zxid']
 
     # -- transaction apply (leader commit path + replica replay) --
+
+    def apply_entry(self, entry: tuple) -> None:
+        """Apply one self-contained commit-log entry to this tree —
+        the single replay dispatch shared by replica catch-up
+        (:class:`ReplicaStore`) and WAL recovery (server/persist.py),
+        so a replayed transaction produces a byte-identical Stat on
+        every member *and* after a restart from disk."""
+        op = entry[0]
+        if op == 'create':
+            _, path, data, acl, eph_owner, zxid, now = entry
+            self._apply_create(path, data, acl, eph_owner, zxid, now)
+        elif op == 'delete':
+            self._apply_delete(entry[1], entry[2])
+        elif op == 'set_data':
+            _, path, data, zxid, now = entry
+            self._apply_set_data(path, data, zxid, now)
+        else:  # pragma: no cover - log entries are produced above
+            raise AssertionError('unknown log entry %r' % (op,))
 
     def _apply_create(self, path: str, data: bytes, acl: tuple,
                       ephemeral_owner: int, zxid: int, now: int) -> None:
@@ -232,6 +251,15 @@ class ZKDatabase(NodeTree):
         #: grow memory without bound either.
         self.log: list[tuple] = []
         self.log_base = 0
+        #: The zxid the retained log is contiguous *after*: every txn
+        #: with zxid > log_start_zxid is in ``log``.  Maintained so a
+        #: follower recovering from its own WAL (server/persist.py)
+        #: can rejoin with its recovered zxid as the catch-up base —
+        #: shipped only the tail — instead of a full snapshot fetch.
+        self.log_start_zxid = 0
+        #: Optional write-ahead log (server/persist.py): when set,
+        #: ``_commit`` appends every txn BEFORE its ack can leave.
+        self.wal = None
         self._replicas: list['ReplicaStore'] = []
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
         self._next_session = ((int(time.time() * 1000) << 24)
@@ -262,8 +290,44 @@ class ZKDatabase(NodeTree):
         logged — so unlike :meth:`attach_replica` it may join at any
         time.  Returns the absolute log index the snapshot is current
         through (the joiner's starting ``applied``)."""
+        if not self._replicas and not self.log:
+            # the log starts recording at this attach: it is
+            # contiguous only after the current position
+            self.log_start_zxid = self.zxid
         self._replicas.append(replica)
         return self.log_end()
+
+    def attach_replica_resync(self, replica, have_zxid: int
+                              ) -> int | None:
+        """Attach a follower that recovered its tree from disk at
+        ``have_zxid`` (server/persist.py): when the retained log still
+        covers that position, the follower needs only the tail — its
+        recovered zxid is the catch-up base, no snapshot fetch.
+        Returns the absolute log index to ship from, or None when the
+        log no longer (or never) covers ``have_zxid`` and the caller
+        must fall back to the snapshot bootstrap."""
+        pos = self.index_after_zxid(have_zxid)
+        if pos is None:
+            return None
+        self._replicas.append(replica)
+        return pos
+
+    def index_after_zxid(self, have_zxid: int) -> int | None:
+        """Absolute log index of the first retained entry with zxid >
+        ``have_zxid``; None when the retained log does not cover that
+        position (truncated past it, never recorded, or the caller is
+        ahead of this leader)."""
+        if have_zxid < self.log_start_zxid or have_zxid > self.zxid:
+            return None
+        from .persist import entry_zxid
+        lo, hi = 0, len(self.log)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if entry_zxid(self.log[mid]) <= have_zxid:
+                lo = mid + 1
+            else:
+                hi = mid
+        return self.log_base + lo
 
     #: Truncate the applied-everywhere log prefix in chunks (a del of
     #: a list prefix is O(surviving entries) — amortize it).
@@ -284,11 +348,55 @@ class ZKDatabase(NodeTree):
         """Absolute index one past the newest log entry."""
         return self.log_base + len(self.log)
 
+    def recover_from_disk(self) -> None:
+        """Rebuild this database's state from its WAL directory — the
+        in-process analogue of a leader process dying and restarting
+        (``ZKServer.restart(from_disk=True)``).  Sessions do not
+        survive a crash (their timers died with the process); their
+        ephemerals are reaped by logged deletes after the reload.
+        Standalone/leader only: attached replicas hold live trees this
+        reload would silently diverge from."""
+        from .persist import reap_orphan_ephemerals, recover_state
+
+        wal = self.wal
+        assert wal is not None, 'recover_from_disk needs a WAL'
+        assert not self._replicas, \
+            'recover_from_disk is standalone/leader-rebuild only'
+        wal.close()
+        rec = recover_state(wal.dir)
+        for sess in self.sessions.values():
+            if sess.expiry_handle is not None:
+                sess.expiry_handle.cancel()
+                sess.expiry_handle = None
+        self.sessions.clear()
+        self.nodes = rec.nodes
+        self.zxid = rec.zxid
+        self.log.clear()
+        self.log_base = 0
+        self.log_start_zxid = rec.zxid
+        # the SAME WriteAheadLog object reopens: collector-bound
+        # gauges/histograms and the fault injector stay live on it
+        wal.reopen()
+        reap_orphan_ephemerals(self)
+
     def _commit(self, entry: tuple) -> None:
+        # durability first: the WAL append precedes the 'committed'
+        # emit (and therefore every replica push and — because the
+        # handler corks the ack after this returns — every ack byte)
+        if self.wal is not None:
+            self.wal.append(entry)
         if self._replicas:
             self.log.append(entry)
             self.emit('committed')
             self._truncate_applied()
+        else:
+            # nothing attached: the entry is not retained, so the log
+            # is only contiguous after this point (a stale prefix from
+            # a detached replica era would otherwise read as coverage)
+            if self.log:
+                self.log_base += len(self.log)
+                self.log.clear()
+            self.log_start_zxid = self.zxid
 
     def _truncate_applied(self) -> None:
         """Drop the log prefix every attached replica has applied —
@@ -297,6 +405,9 @@ class ZKDatabase(NodeTree):
         ensemble's memory without bound."""
         floor = min(r.applied for r in self._replicas)
         if floor - self.log_base >= self.LOG_TRUNC_CHUNK:
+            from .persist import entry_zxid
+            self.log_start_zxid = entry_zxid(
+                self.log[floor - self.log_base - 1])
             del self.log[:floor - self.log_base]
             self.log_base = floor
 
@@ -464,7 +575,22 @@ class ReplicaStore(NodeTree):
         #: trigger it on the loop; an unguarded read-modify-write of
         #: ``applied`` would skip or double-apply an entry.
         self._apply_lock = threading.Lock()
-        leader.attach_replica(self)
+        try:
+            leader.attach_replica(self)
+        except ValueError:
+            # the leader already has history — e.g. it was recovered
+            # from its WAL (server/persist.py) before this follower
+            # existed: bootstrap from an image at the current
+            # position, exactly like a cross-process late joiner.
+            # The image is deep-copied (pickle roundtrip, same as the
+            # wire would do): an in-process replica must not alias
+            # the leader's live tree or lag would be unobservable.
+            import pickle
+            pos = leader.attach_replica_at_tail(self)
+            self.install({'zxid': leader.zxid,
+                          'nodes': pickle.loads(
+                              pickle.dumps(leader.nodes))})
+            self.applied = pos
         leader.on('committed', self._on_commit)
 
     def _on_commit(self) -> None:
@@ -489,17 +615,7 @@ class ReplicaStore(NodeTree):
                 self.applied += 1
 
     def _apply_one(self, entry: tuple) -> None:
-        op = entry[0]
-        if op == 'create':
-            _, path, data, acl, eph_owner, zxid, now = entry
-            self._apply_create(path, data, acl, eph_owner, zxid, now)
-        elif op == 'delete':
-            self._apply_delete(entry[1], entry[2])
-        elif op == 'set_data':
-            _, path, data, zxid, now = entry
-            self._apply_set_data(path, data, zxid, now)
-        else:  # pragma: no cover - log entries are produced above
-            raise AssertionError('unknown log entry %r' % (op,))
+        self.apply_entry(entry)
 
     def catch_up(self) -> None:
         """Apply everything committed so far — what a write through

@@ -238,6 +238,22 @@ class Histogram:
         return '\n'.join(lines)
 
 
+def sign_test_p(wins: int, losses: int) -> float:
+    """Two-sided exact sign test (ties dropped): the probability of a
+    split at least this lopsided under H0 = deltas symmetric around 0.
+    Shared by every paired A/B study (tools/sweep_crossover.py's cork
+    pairs, bench.py --wal's durability arms) so the published p-value
+    tables can never drift apart."""
+    import math
+
+    n = wins + losses
+    if n == 0:
+        return 1.0
+    k = min(wins, losses)
+    p = 2.0 * sum(math.comb(n, i) for i in range(k + 1)) / (2.0 ** n)
+    return min(1.0, p)
+
+
 class Collector:
     def __init__(self) -> None:
         self._counters: dict[str, Counter] = {}
