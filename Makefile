@@ -7,7 +7,7 @@ PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check native bench asan chaos chaos-ensemble obs \
-    durability bench-wal coverage clean
+    durability bench-wal bench-fanout coverage clean
 
 all: check test
 
@@ -51,6 +51,15 @@ durability:
 # plane, not this image's 9p filesystem).
 bench-wal:
 	$(PYTHON) bench.py --wal
+
+# Serving-plane fan-out envelope: the sharded watch table vs the
+# per-connection emitter dispatch (server/watchtable.py), paired
+# table/emitter cells over the 1k/10k/100k-session x watchers sweep
+# with exact sign tests and per-shard flush-batch + tick histograms
+# (table in PROFILE.md "Fan-out plane").  Rounds via
+# ZKSTREAM_BENCH_FANOUT_ROUNDS; narrow with --sessions/--watchers.
+bench-fanout:
+	$(PYTHON) bench.py --fanout
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), xid-correlated op tracing, and the four-letter admin

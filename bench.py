@@ -1100,6 +1100,222 @@ def bench_wal() -> None:
             }), flush=True)
 
 
+#: `bench.py --fanout` sweep (the serving-plane cell family): sessions
+#: on the box x watchers on the hot path.  -1 = every session watches.
+FANOUT_SESSIONS = (1000, 10000, 100000)
+FANOUT_WATCHERS = (1, 100, -1)
+
+
+class _NullWriter:
+    """A transport sink for fan-out cells: counts what the server
+    writes, delivers nowhere.  The cell measures the serving plane's
+    dispatch + encode + flush path (the thing the watch table owns);
+    100k real sockets would measure the kernel instead."""
+
+    __slots__ = ('nbytes', 'writes', 'sink')
+
+    def __init__(self, sink):
+        self.nbytes = 0
+        self.writes = 0
+        self.sink = sink
+
+    def write(self, data):
+        self.nbytes += len(data)
+        self.writes += 1
+        self.sink[0] += len(data)
+
+    def close(self):
+        pass
+
+    def get_extra_info(self, name, default=None):
+        return default
+
+
+async def fanout_cell(sessions: int, watchers: int, table: bool,
+                      events: int | None = None,
+                      collector=None) -> dict:
+    """One serving-plane fan-out measurement: ``sessions`` in-process
+    server connections over a null transport, ``watchers`` of them
+    holding a data watch on one hot path.  Fires ``events`` SET_DATA
+    mutations (re-arming between events) and times each
+    mutation -> all-notification-bytes-flushed window.
+
+    ``table=True`` runs the sharded watch table
+    (server/watchtable.py); ``table=False`` the per-connection emitter
+    fallback — the paired arm, where every event costs O(sessions)
+    callbacks regardless of ``watchers``."""
+    import asyncio
+
+    from zkstream_tpu.protocol.consts import CreateFlag
+    from zkstream_tpu.server import ZKDatabase, ZKServer
+    from zkstream_tpu.server.server import ServerConnection
+
+    loop = asyncio.get_running_loop()
+    db = ZKDatabase()
+    # never started: no listener, no kernel sockets — connections are
+    # wired straight to null transports below
+    srv = ZKServer(db=db, watchtable=table, collector=collector)
+    total = [0]
+    conns = []
+    for _ in range(sessions):
+        conn = ServerConnection(srv, reader=None,
+                                writer=_NullWriter(total))
+        conn._subscribe()
+        srv.conns.add(conn)
+        conns.append(conn)
+    db.create('/hot', b'', [], CreateFlag(0))
+    watcher_conns = conns[:watchers]
+    # one frame's wire size (constant per event: fixed-width header +
+    # this path), to know when an event's fan-out has fully flushed
+    frame_len = len(srv.encode_notification('DATA_CHANGED', '/hot', 1))
+    if events is None:
+        # emitter-arm cost is O(sessions) per event: keep big cells
+        # bounded, small cells statistically useful
+        events = max(3, min(30, 200000 // max(sessions, 1)))
+    lat_ms = []
+    payload = b'z' * 64
+    try:
+        for _ in range(events):
+            for c in watcher_conns:
+                c._arm_data('/hot')
+            expect = total[0] + watchers * frame_len
+            t0 = loop.time()
+            db.set_data('/hot', payload, -1)
+            deadline = t0 + 30.0
+            while total[0] < expect:
+                await asyncio.sleep(0)
+                if loop.time() > deadline:
+                    raise TimeoutError(
+                        'fan-out stalled: %d/%d bytes'
+                        % (total[0], expect))
+            lat_ms.append((loop.time() - t0) * 1000.0)
+    finally:
+        if not table:
+            # The emitter arm's clean close is O(listeners) PER
+            # CONNECTION (EventEmitter.remove_listener scans the
+            # store's listener list), i.e. O(sessions^2) for the whole
+            # fleet — hours at 100k, and itself part of why the table
+            # exists (table-mode close is O(paths watched)).  The cell
+            # measures dispatch, not teardown: drop the listeners
+            # wholesale first so close() sees empty lists.
+            for evt in ('created', 'deleted', 'dataChanged',
+                        'childrenChanged'):
+                db.remove_all_listeners(evt)
+        for c in conns:
+            c.close()
+    p50, p99 = _percentiles(lat_ms)
+    out = {'sessions': sessions, 'watchers': watchers,
+           'table': table, 'events': events,
+           'event_ms_mean': round(sum(lat_ms) / len(lat_ms), 3),
+           'event_ms_p50': round(p50, 3),
+           'event_ms_p99': round(p99, 3),
+           'notifs_per_sec': round(
+               watchers * events / (sum(lat_ms) / 1000.0), 1)}
+    if collector is not None and table:
+        from zkstream_tpu.server.watchtable import METRIC_FANOUT_TICK
+        try:
+            tick = collector.get_collector(METRIC_FANOUT_TICK)
+        except ValueError:
+            tick = None
+        if tick is not None and tick.count({'plane': 'fanout'}):
+            labels = {'plane': 'fanout'}
+            out['fanout_tick_ms'] = {
+                'count': tick.count(labels),
+                'p50': round(tick.percentile(50, labels), 3),
+                'p99': round(tick.percentile(99, labels), 3)}
+        from zkstream_tpu.io.sendplane import scrape_flush_cells
+        flush = scrape_flush_cells(collector).get('fanout')
+        if flush:
+            out['fanout_flush_batches'] = flush
+    return out
+
+
+def _arg_ints(flag: str) -> list[int] | None:
+    """Parse ``--flag 1000,10000`` style comma-lists from sys.argv."""
+    if flag not in sys.argv:
+        return None
+    idx = sys.argv.index(flag)
+    if idx + 1 >= len(sys.argv):
+        return None
+    return [int(x) for x in sys.argv[idx + 1].split(',') if x]
+
+
+def bench_fanout() -> None:
+    """The serving-plane fan-out envelope (`make bench-fanout`):
+    paired table-vs-emitter cells over the sessions x watchers sweep,
+    per-round adjacent A/B runs, exact two-sided sign test on the
+    per-event fan-out latency — PROFILE.md methodology, same as the
+    cork and WAL families.  The acceptance bar: the table is not
+    significantly slower at any cell and significantly faster at the
+    high-watcher/low-coverage cells where the emitter pays
+    O(sessions) per event.  Scale with ZKSTREAM_BENCH_FANOUT_ROUNDS;
+    narrow the sweep with ``--sessions/--watchers`` comma-lists."""
+    import asyncio
+
+    from zkstream_tpu.utils.metrics import Collector, sign_test_p
+
+    sessions_sweep = _arg_ints('--sessions') or list(FANOUT_SESSIONS)
+    watchers_sweep = _arg_ints('--watchers') or list(FANOUT_WATCHERS)
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_FANOUT_ROUNDS', '10'))
+    rows: dict = {}
+    cells: dict = {}
+    for rnd in range(rounds):
+        for s in sessions_sweep:
+            for w in watchers_sweep:
+                wn = s if w < 0 else w
+                if wn > s:
+                    continue
+                # the sign test pairs ADJACENT A/B runs: a round where
+                # either arm failed contributes to neither, so the
+                # surviving pairs stay aligned round-for-round
+                pair = {}
+                for arm_table in (True, False):
+                    col = Collector()
+                    try:
+                        pair[arm_table] = asyncio.run(fanout_cell(
+                            s, wn, arm_table, collector=col))
+                    except Exception as e:
+                        print('# fanout cell %dx%d table=%s round '
+                              'failed: %r' % (s, wn, arm_table, e),
+                              file=sys.stderr)
+                for arm_table, r in pair.items():
+                    key = (s, wn, 'table' if arm_table else 'emitter')
+                    if len(pair) == 2:
+                        rows.setdefault(key, []).append(
+                            r['event_ms_mean'])
+                    if key not in cells or r['event_ms_mean'] < \
+                            cells[key]['event_ms_mean']:
+                        cells[key] = r
+    for key in sorted(cells, key=str):
+        print('# fanout_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for s in sessions_sweep:
+        for w in watchers_sweep:
+            wn = s if w < 0 else w
+            if wn > s:
+                continue
+            a = rows.get((s, wn, 'table'), [])
+            b = rows.get((s, wn, 'emitter'), [])
+            if not a or not b:
+                continue
+            paired = list(zip(a, b))
+            # positive delta = table faster (lower per-event latency)
+            deltas = [(y - x) / y * 100.0 for x, y in paired if y]
+            wins = sum(1 for x, y in paired if x < y)
+            losses = sum(1 for x, y in paired if x > y)
+            print(json.dumps({
+                'metric': 'fanout_table_sign_test',
+                'sessions': s,
+                'watchers': wn,
+                'rounds': len(paired),
+                'wins': wins,
+                'losses': losses,
+                'mean_delta_pct': round(sum(deltas)
+                                        / max(1, len(deltas)), 1),
+                'sign_p': round(sign_test_p(wins, losses), 4),
+            }), flush=True)
+
+
 def _guard_backend(timeout_s: float | None = None) -> None:
     """Probe the default JAX backend in a SUBPROCESS before this
     process touches jax: a wedged tunneled-TPU backend has been
@@ -1172,6 +1388,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_wal()
+        return
+    if '--fanout' in sys.argv:
+        # `make bench-fanout`: the serving-plane fan-out cell family
+        # (sharded watch table vs per-connection emitter dispatch).
+        # Host-path only; no accelerator probe, no kernel sockets.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_fanout()
         return
     if '--write' in sys.argv:
         # `make bench-write`: the write-heavy client-ops cell family

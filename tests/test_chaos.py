@@ -102,6 +102,15 @@ def test_campaign_runs_with_coalescing_enabled():
         'ZKSTREAM_NO_CORK must not be set for the tier-1 campaign'
 
 
+def test_campaign_runs_with_watchtable_enabled():
+    # same rationale for the sharded watch fan-out
+    # (server/watchtable.py); the emitter-fallback slice lives in
+    # tests/test_watchtable.py
+    from zkstream_tpu.server.watchtable import watchtable_default
+    assert watchtable_default(), \
+        'ZKSTREAM_NO_WATCHTABLE must not be set for the tier-1 campaign'
+
+
 @pytest.mark.timeout(240)
 @pytest.mark.parametrize('batch', range(BATCHES))
 async def test_chaos_campaign(batch):

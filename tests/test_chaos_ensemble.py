@@ -309,6 +309,17 @@ def _campaign_failure_report(bad) -> str:
     return '\n'.join(lines)
 
 
+def test_campaign_runs_with_watchtable_enabled():
+    """The ensemble campaign runs with the sharded watch fan-out
+    (server/watchtable.py) in its default-enabled state — a stray
+    ZKSTREAM_NO_WATCHTABLE must not silently weaken what these
+    schedules exercise.  The emitter-fallback slice lives in
+    tests/test_watchtable.py."""
+    from zkstream_tpu.server.watchtable import watchtable_default
+    assert watchtable_default(), \
+        'ZKSTREAM_NO_WATCHTABLE must not be set for the tier-1 campaign'
+
+
 @pytest.mark.timeout(90)
 async def test_kill_recover_rides_every_schedule():
     """The durability plane's kill/recover pass (invariant 6) runs

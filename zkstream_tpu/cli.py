@@ -328,6 +328,12 @@ def build_parser() -> argparse.ArgumentParser:
                          'transport, 12 plan steps for ensemble)')
     ch.add_argument('--quiet', action='store_true',
                     help='only print failing schedules + the summary')
+    ch.add_argument('--no-watchtable', action='store_true',
+                    help='rerun on the per-connection emitter '
+                         'fallback instead of the sharded watch '
+                         'fan-out table (server/watchtable.py) — '
+                         'bisects whether a failing seed implicates '
+                         'the table')
     ch.add_argument('--trace-out', metavar='PATH', default=None,
                     help='write every schedule\'s xid-correlated span '
                          'dump — member kill/restart events included '
@@ -386,6 +392,11 @@ async def _chaos(args) -> int:
     from .io.faults import run_campaign, run_ensemble_campaign
     from .io.invariants import format_history
     from .utils.trace import format_spans
+
+    if getattr(args, 'no_watchtable', False):
+        # the schedule servers resolve their dispatch path from the
+        # env at construction, exactly like the cork/codec tiers
+        os.environ['ZKSTREAM_NO_WATCHTABLE'] = '1'
 
     def progress(r):
         if args.quiet and r.ok:

@@ -372,12 +372,15 @@ class FaultInjector:
         """Server-side write hook.  Returns True when the injector took
         over delivery (split/delay/reset), False for pass-through.
 
-        ``pre`` (the connection's send-plane ``flush_hard``) runs before
-        the injector's first delivery whenever it takes over: frames
+        ``pre`` (the connection's send-plane ``flush_hard``, or — on
+        the watch-table fan-out path — its ``_preflush_fanout``, which
+        drains the buffered notifications first) runs before the
+        injector's first delivery whenever it takes over: frames
         corked in earlier (un-faulted) writes must hit the wire first
         or the stream would reorder in a way TCP never does.  The hook
         itself stays a per-frame boundary — injection happens before
-        the cork, and a faulted frame bypasses it."""
+        the cork (send plane AND shard cork alike), and a faulted
+        frame bypasses both."""
         cfg = self.config
         wants_reset = self._take('server_tx', cfg.p_server_tx_reset,
                                  'server tx mid-frame reset')

@@ -29,8 +29,10 @@ frames.
 
 Observability: per-flush batch size lands in the
 ``zookeeper_flush_batch_frames`` / ``zookeeper_flush_batch_bytes``
-histograms (labelled ``plane="client"|"server"``), scraped by bench.py
-write-heavy cells and tools/sweep_crossover.py.
+histograms (labelled ``plane="client"|"server"``; the watch table's
+per-shard fan-out flushes record under ``plane="fanout"``,
+server/watchtable.py), scraped by bench.py write-heavy cells,
+``bench.py --fanout`` and tools/sweep_crossover.py.
 
 ``ZKSTREAM_NO_CORK=1`` (or ``cork=False`` on Client / ZKServer)
 degrades to write-through — every frame still flows through the plane
@@ -141,6 +143,19 @@ class SendPlane:
 
     def _tick_flush(self) -> None:
         self._scheduled = False
+        self.flush_now()
+
+    def send_flush(self, data: bytes) -> None:
+        """Append one frame and flush immediately — for callers that
+        ARE the tick boundary (the watch table's per-shard fan-out
+        flush, server/watchtable.py): scheduling the usual deferred
+        tick flush from here would add one loop-callback round trip
+        per connection per tick, the dominant cost of a 100k-watcher
+        fan-out.  Anything already corked (this tick's replies)
+        leaves in the same buffer, order preserved; the durability
+        barrier is honored exactly as in :meth:`flush_now`."""
+        self._chunks.append(data)
+        self._pending += len(data)
         self.flush_now()
 
     def flush_now(self) -> None:

@@ -16,6 +16,7 @@ from .replication import (  # noqa: F401
     ReplicationService,
 )
 from .server import ServerConnection, ZKEnsemble, ZKServer  # noqa: F401
+from .watchtable import WatchTable, watchtable_default  # noqa: F401
 from .store import (  # noqa: F401
     NodeTree,
     ReplicaStore,

@@ -29,6 +29,7 @@ from ..io.sendplane import SendPlane
 from ..protocol.framing import PacketCodec
 from ..utils.aio import set_nodelay
 from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
+from .watchtable import WatchTable, watchtable_default
 
 log = logging.getLogger('zkstream_tpu.server')
 
@@ -51,11 +52,20 @@ class ServerConnection:
         self.codec = PacketCodec(server=True)
         self.session: ZKServerSession | None = None
         #: One-shot watch tables, local to this connection (they die
-        #: with the server, exactly like real ZK's).
+        #: with the server, exactly like real ZK's).  With the server's
+        #: WatchTable enabled (the default) these dicts are the
+        #: per-connection view of the same registrations the table's
+        #: reverse index holds — always mutate both through the
+        #: ``_arm_*``/``_disarm_*`` helpers.
         self.data_watches: dict[str, bool] = {}
         self.child_watches: dict[str, bool] = {}
         self.closed = False
         self._subscribed = False
+        #: Sharded fan-out state (server/watchtable.py): notifications
+        #: buffered for this connection within the current tick, and
+        #: the shard this connection drains through.
+        self._fanout_buf: list[bytes] = []
+        self._fanout_shard = 0
         #: First-bytes buffer for four-letter admin word detection: a
         #: real ZK handshake starts with a 4-byte big-endian length
         #: (0x00 0x00 0x00 0x2c-ish), which can never collide with an
@@ -86,11 +96,35 @@ class ServerConnection:
     def _write_bytes(self, data: bytes) -> None:
         if self.closed:
             return
+        # notifications buffered by the watch table this tick must
+        # leave before any later reply: the wire never shows a reply
+        # overtaking an earlier notification (ZooKeeper's watch-
+        # before-read-result guarantee)
+        if self._fanout_buf:
+            self._drain_fanout()
         fi = self.server.faults
         if fi is not None and fi.server_tx(self, data,
                                            pre=self._tx.flush_hard):
             return   # the injector took over delivery (split/delay/RST)
         self._tx.send(data)
+
+    def _drain_fanout(self) -> None:
+        """Move this connection's buffered (already fault-screened)
+        notifications into the send plane, joined, in event order."""
+        buf = self._fanout_buf
+        if not buf:
+            return
+        data = buf[0] if len(buf) == 1 else b''.join(buf)
+        buf.clear()          # the list object is reused across ticks
+        self._tx.send(data)
+
+    def _preflush_fanout(self) -> None:
+        """Fault-injection pre-flush: everything this connection has
+        corked — buffered notifications AND the plane — hits the wire
+        before an injected delivery, so a faulted frame cannot
+        reorder (the send plane's boundary rule)."""
+        self._drain_fanout()
+        self._tx.flush_hard()
 
     def _send(self, pkt: dict) -> None:
         if self.closed:
@@ -112,55 +146,50 @@ class ServerConnection:
         self._send(pkt)
 
     def notify(self, ntype: str, path: str, zxid: int) -> None:
-        """Send a watch notification for the change ``zxid``; a fan-out
-        (one change, many subscribed connections) encodes the identical
-        packet ONCE and shares the bytes — keyed by (type, path, zxid),
-        which is unique per change since zxid strictly increases per
-        mutation."""
+        """Send one watch notification directly (the SET_WATCHES
+        catch-up path; event-driven fan-out goes through the server's
+        WatchTable instead).  The bytes come from the server-owned
+        encode cache/memo, shared across subscribers."""
         if self.closed:
             return
         self.server.packets_sent += 1
-        key = (ntype, path, zxid)
-        cache = self.server._notif_cache
-        if cache is not None and cache[0] == key:
-            data = cache[1]
-        else:
-            # Encode through the server-owned connection-independent
-            # codec, not this connection's: the cached bytes are shared
-            # with every subscribed connection, so they must not depend
-            # on any per-connection encode state.
-            data = self.server._notif_codec.encode(
-                {'xid': XID_NOTIFICATION, 'zxid': zxid,
-                 'err': 'OK', 'opcode': 'NOTIFICATION', 'type': ntype,
-                 'state': 'SYNC_CONNECTED', 'path': path})
-            self.server._notif_cache = (key, data)
-        self._write_bytes(data)
+        self._write_bytes(
+            self.server.encode_notification(ntype, path, zxid))
 
-    # -- watch dispatch (db change events -> this connection) --
+    # -- watch dispatch (store change events -> this connection) --
 
     def _subscribe(self) -> None:
         if self._subscribed:
             return
         self._subscribed = True
-        # node-change events come from THIS member's store (a watch on
-        # a lagging follower fires when the follower applies the
-        # transaction); session expiry is leader-global state
+        if self.server.watch_table is not None:
+            # table mode (default): the server's one listener set per
+            # store consults the reverse index; this connection only
+            # joins a fan-out shard
+            self.server.watch_table.add_conn(self)
+            return
+        # emitter fallback (ZKSTREAM_NO_WATCHTABLE=1): per-connection
+        # store listeners, each event filtered against this
+        # connection's own dicts — the validator path.  Node-change
+        # events come from THIS member's store (a watch on a lagging
+        # follower fires when the follower applies the transaction).
         self.store.on('created', self._on_created)
         self.store.on('deleted', self._on_deleted)
         self.store.on('dataChanged', self._on_data_changed)
         self.store.on('childrenChanged', self._on_children_changed)
-        self.db.on('sessionExpired', self._on_session_expired)
 
     def _unsubscribe(self) -> None:
         if not self._subscribed:
             return
         self._subscribed = False
+        if self.server.watch_table is not None:
+            self.server.watch_table.remove_conn(self)
+            return
         self.store.remove_listener('created', self._on_created)
         self.store.remove_listener('deleted', self._on_deleted)
         self.store.remove_listener('dataChanged', self._on_data_changed)
         self.store.remove_listener('childrenChanged',
                                    self._on_children_changed)
-        self.db.remove_listener('sessionExpired', self._on_session_expired)
 
     def _on_created(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
@@ -180,9 +209,29 @@ class ServerConnection:
         if self.child_watches.pop(path, None):
             self.notify('CHILDREN_CHANGED', path, zxid)
 
-    def _on_session_expired(self, session_id: int) -> None:
-        if self.session is not None and self.session.id == session_id:
-            self.close()
+    # -- watch arming (both paths: connection dict + table index) --
+
+    def _arm_data(self, path: str) -> None:
+        if path not in self.data_watches:
+            self.data_watches[path] = True
+            if self.server.watch_table is not None:
+                self.server.watch_table.arm('data', path, self)
+
+    def _arm_child(self, path: str) -> None:
+        if path not in self.child_watches:
+            self.child_watches[path] = True
+            if self.server.watch_table is not None:
+                self.server.watch_table.arm('child', path, self)
+
+    def _disarm_data(self, path: str) -> None:
+        if self.data_watches.pop(path, None):
+            if self.server.watch_table is not None:
+                self.server.watch_table.disarm('data', path, self)
+
+    def _disarm_child(self, path: str) -> None:
+        if self.child_watches.pop(path, None):
+            if self.server.watch_table is not None:
+                self.server.watch_table.disarm('child', path, self)
 
     # -- lifecycle --
 
@@ -254,8 +303,10 @@ class ServerConnection:
     def close(self) -> None:
         if self.closed:
             return
-        # corked replies (e.g. the CLOSE_SESSION ack) must beat the
-        # FIN — and their durability barrier, taken synchronously
+        # corked replies (e.g. the CLOSE_SESSION ack) and buffered
+        # notifications must beat the FIN — and their durability
+        # barrier, taken synchronously
+        self._drain_fanout()
         self._tx.flush_hard()
         self.closed = True
         self._unsubscribe()
@@ -336,7 +387,7 @@ class ServerConnection:
         except ZKOpError:
             raise
         if pkt.get('watch'):
-            self.data_watches[pkt['path']] = True
+            self._arm_data(pkt['path'])
         self._reply(pkt['xid'], 'GET_DATA', data=data, stat=stat)
 
     def _op_set_data(self, pkt: dict) -> None:
@@ -351,22 +402,22 @@ class ServerConnection:
             # EXISTS with watch on a missing node arms an existence
             # watch that fires CREATED later.
             if pkt.get('watch'):
-                self.data_watches[pkt['path']] = True
+                self._arm_data(pkt['path'])
             raise
         if pkt.get('watch'):
-            self.data_watches[pkt['path']] = True
+            self._arm_data(pkt['path'])
         self._reply(pkt['xid'], 'EXISTS', stat=stat)
 
     def _op_get_children(self, pkt: dict) -> None:
         children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
-            self.child_watches[pkt['path']] = True
+            self._arm_child(pkt['path'])
         self._reply(pkt['xid'], 'GET_CHILDREN', children=children)
 
     def _op_get_children2(self, pkt: dict) -> None:
         children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
-            self.child_watches[pkt['path']] = True
+            self._arm_child(pkt['path'])
         self._reply(pkt['xid'], 'GET_CHILDREN2', children=children,
                     stat=stat)
 
@@ -402,11 +453,14 @@ class ServerConnection:
             node = self.store.nodes.get(path)
             if node is None:
                 self.notify('DELETED', path, z)
+            elif node.mzxid > rel:
+                # moved past relZxid: the catch-up notification IS the
+                # one-shot fire — it consumes any pre-existing arm
+                # instead of re-arming
+                self._disarm_data(path)
+                self.notify('DATA_CHANGED', path, node.mzxid)
             else:
-                self.data_watches[path] = True
-                if node.mzxid > rel:
-                    self.data_watches.pop(path, None)
-                    self.notify('DATA_CHANGED', path, node.mzxid)
+                self._arm_data(path)
         for path in events.get('createdOrDestroyed', ()):
             node = self.store.nodes.get(path)
             if node is None:
@@ -417,16 +471,16 @@ class ServerConnection:
             elif node.czxid > rel:
                 self.notify('CREATED', path, node.czxid)
             else:
-                self.data_watches[path] = True
+                self._arm_data(path)
         for path in events.get('childrenChanged', ()):
             node = self.store.nodes.get(path)
             if node is None:
                 self.notify('DELETED', path, z)
+            elif node.pzxid > rel:
+                self._disarm_child(path)
+                self.notify('CHILDREN_CHANGED', path, node.pzxid)
             else:
-                self.child_watches[path] = True
-                if node.pzxid > rel:
-                    self.child_watches.pop(path, None)
-                    self.notify('CHILDREN_CHANGED', path, node.pzxid)
+                self._arm_child(path)
         self._reply(pkt['xid'], 'SET_WATCHES')
 
 
@@ -441,7 +495,9 @@ class ZKServer:
                  host: str = '127.0.0.1', port: int = 0,
                  store=None, cork: bool | None = None,
                  collector=None, durability: str | None = None,
-                 wal_dir: str | None = None):
+                 wal_dir: str | None = None,
+                 watchtable: bool | None = None,
+                 fanout_shards: int | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -486,14 +542,31 @@ class ZKServer:
         #: Optional seeded FaultInjector (io/faults.py): accept-loop
         #: refusals and reply-path splits/delays/mid-frame resets.
         self.faults = None
-        #: one-slot encode cache for notification fan-out
-        #: ((type, path, zxid), wire bytes), filled via the dedicated
-        #: connection-independent codec below (the bytes are shared
-        #: across subscribers, so no per-connection codec may encode
-        #: them)
+        #: one-slot encode cache for the emitter-fallback notification
+        #: path ((type, path, zxid), wire bytes), filled via the
+        #: dedicated connection-independent codec below (the bytes are
+        #: shared across subscribers, so no per-connection codec may
+        #: encode them); the watch table replaces it with a per-tick
+        #: memo (server/watchtable.py)
         self._notif_cache: tuple[tuple, bytes] | None = None
         self._notif_codec = PacketCodec(server=True)
         self._notif_codec.handshaking = False
+        #: The serving plane's sharded watch fan-out
+        #: (server/watchtable.py): a reverse (kind, path) → subscriber
+        #: index consulted once per store event, with per-shard corked
+        #: notification flushes.  None = process default
+        #: (``ZKSTREAM_NO_WATCHTABLE=1`` falls back to the
+        #: per-connection emitter path), True/False force.
+        enabled = watchtable_default() if watchtable is None \
+            else watchtable
+        self.watch_table = WatchTable(self, shards=fanout_shards,
+                                      collector=collector) \
+            if enabled else None
+        #: Session expiry is dispatched once per member through the
+        #: session's ``owner`` pointer (the session-id → connection
+        #: map the database already maintains) — O(1) per expiry, not
+        #: one callback per connection.
+        self.db.on('sessionExpired', self._on_session_expired)
         #: Introspection counters for the four-letter admin words
         #: (mntr/stat/srvr): requests decoded, replies/notifications
         #: sent, and requests decoded but not yet replied (batch-
@@ -502,6 +575,41 @@ class ZKServer:
         self.packets_received = 0
         self.packets_sent = 0
         self.outstanding = 0
+
+    def encode_notification(self, ntype: str, path: str,
+                            zxid: int) -> bytes:
+        """Wire bytes for one notification, shared across subscribers:
+        the watch table's per-tick memo when the table is on, the
+        legacy depth-1 cache on the emitter fallback."""
+        if self.watch_table is not None:
+            return self.watch_table.encode(ntype, path, zxid)
+        key = (ntype, path, zxid)
+        cache = self._notif_cache
+        if cache is not None and cache[0] == key:
+            return cache[1]
+        data = self._notif_codec.encode(
+            {'xid': XID_NOTIFICATION, 'zxid': zxid, 'err': 'OK',
+             'opcode': 'NOTIFICATION', 'type': ntype,
+             'state': 'SYNC_CONNECTED', 'path': path})
+        self._notif_cache = (key, data)
+        return data
+
+    def _on_session_expired(self, session_id: int) -> None:
+        """One callback per member per expiry: the expiring session's
+        ``owner`` pointer names the serving connection directly, so no
+        connection scan happens (and members not serving the session
+        do nothing)."""
+        sess = self.db.sessions.get(session_id)
+        owner = getattr(sess, 'owner', None)
+        if owner is not None and owner in self.conns:
+            owner.close()
+            return
+        if sess is None:
+            # a mirror that already dropped the entry (cross-process
+            # member): fall back to the scan — rare, never hot
+            for c in list(self.conns):
+                if c.session is not None and c.session.id == session_id:
+                    c.close()
 
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(
@@ -574,7 +682,11 @@ class ZKServer:
     # -- four-letter admin words (ruok / mntr / stat / srvr) --
 
     def watch_count(self) -> int:
-        """Armed one-shot watches across this member's connections."""
+        """Armed one-shot watches across this member's connections —
+        the watch table's maintained counter (O(1) per scrape); the
+        emitter fallback keeps the legacy O(connections) sum."""
+        if self.watch_table is not None:
+            return self.watch_table.count
         return sum(len(c.data_watches) + len(c.child_watches)
                    for c in self.conns)
 
@@ -609,6 +721,9 @@ class ZKServer:
             ('zk_approximate_data_size', data_size),
             ('zk_sessions', len(self.db.sessions)),
             ('zk_zxid', '0x%x' % (self.store.zxid,)),
+            ('zk_fanout_shards',
+             0 if self.watch_table is None
+             else self.watch_table.nshards),
         ] + wal_rows
 
     def admin_text(self, word: str) -> str:
@@ -657,7 +772,8 @@ class ZKEnsemble:
                  lag: float | None = 0.0,
                  wal_dir: str | None = None,
                  durability: str | None = None,
-                 collector=None, wal_segment_bytes: int | None = None):
+                 collector=None, wal_segment_bytes: int | None = None,
+                 watchtable: bool | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -679,7 +795,8 @@ class ZKEnsemble:
         self.servers = [
             ZKServer(self.db, host=host,
                      store=None if i == 0 else ReplicaStore(self.db,
-                                                            lag=lag))
+                                                            lag=lag),
+                     watchtable=watchtable)
             for i in range(count)]
 
     def install_faults(self, injector) -> None:
