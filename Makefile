@@ -7,7 +7,8 @@ PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check native bench asan chaos chaos-ensemble obs \
-    durability bench-wal bench-fanout coverage clean
+    durability bench-wal bench-fanout bench-trace timeline coverage \
+    clean
 
 all: check test
 
@@ -55,18 +56,37 @@ bench-wal:
 # Serving-plane fan-out envelope: the sharded watch table vs the
 # per-connection emitter dispatch (server/watchtable.py), paired
 # table/emitter cells over the 1k/10k/100k-session x watchers sweep
-# with exact sign tests and per-shard flush-batch + tick histograms
-# (table in PROFILE.md "Fan-out plane").  Rounds via
-# ZKSTREAM_BENCH_FANOUT_ROUNDS; narrow with --sessions/--watchers.
+# with exact sign tests, per-shard flush-batch + tick histograms, and
+# the tick-ledger phase table per table-arm cell (table in PROFILE.md
+# "Fan-out plane").  Rounds via ZKSTREAM_BENCH_FANOUT_ROUNDS; narrow
+# with --sessions/--watchers.
 bench-fanout:
 	$(PYTHON) bench.py --fanout
 
 # Observability suite: metrics (counters/gauges/histograms +
-# exposition), xid-correlated op tracing, and the four-letter admin
-# words (ruok/mntr/stat/srvr) — see README "Observability".
+# exposition), causal tracing (client spans + member rings + the
+# zxid-merged timeline), the tick ledger, and the four-letter admin
+# words (ruok/mntr/stat/srvr/trce) — see README "Observability".
 obs:
 	$(PYTHON) -m pytest tests/test_metrics.py tests/test_trace.py \
 	    tests/test_admin_words.py -q
+
+# Causal-tracing demo: run one traced write through an in-process
+# 3-member ensemble (WAL on, watch armed) and print the merged
+# zxid-ordered timeline — client submit, leader commit + WAL append +
+# shared group-fsync span, follower applies, fan-out delivery (README
+# "Causal tracing").  `--live` against a running ensemble:
+# python -m zkstream_tpu --server h:p,h:p timeline --live
+timeline:
+	$(PYTHON) -m zkstream_tpu timeline
+
+# Paired trace-plane overhead envelope: member span rings + tick
+# ledger (the default) vs ZKSTREAM_NO_SERVER_TRACE=1, write-heavy
+# cells at fleet 16/64 with exact sign tests — the acceptance bar is
+# "not significantly slower at any cell".  Rounds via
+# ZKSTREAM_BENCH_TRACE_ROUNDS.
+bench-trace:
+	$(PYTHON) bench.py --traceov
 
 check:
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)
@@ -90,7 +110,9 @@ bench:
 # Write-heavy (SET_DATA/CREATE-dominated) client-ops cells only: the
 # outbound-plane family (single-pass encode + tick-corked coalescing,
 # PROFILE.md "Encode side").  Host-path; prints per-cell flush-batch
-# distributions from zookeeper_flush_batch_frames/_bytes.  The paired
+# distributions from zookeeper_flush_batch_frames/_bytes plus the
+# tick-ledger phase table (zk_tick_phase_ms: decode_apply /
+# fsync_gate / cork_flush / fanout_flush share per cell).  The paired
 # coalescing sign-test lives in tools/sweep_crossover.py
 # (--workload write --paired native,native-nocork).
 bench-write:

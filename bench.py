@@ -953,6 +953,16 @@ async def _client_ops_run(mode: str, n_clients: int,
         # planes — the coalescing observability the write-heavy cells
         # exist to publish.
         out['flush_batches'] = scrape_flush_cells(collector)
+        # The tick ledger (utils/metrics.TickLedger): what fraction of
+        # each busy loop tick the decode/fsync/cork/fan-out planes
+        # ate — the per-cell phase table PROFILE.md's accept-shard and
+        # io_uring items are gated on.
+        from zkstream_tpu.utils.metrics import scrape_tick_cells
+        if srv.ledger is not None:
+            srv.ledger.close_tick()   # flush the residual burst
+        tick = scrape_tick_cells(collector)
+        if tick:
+            out['tick_ledger'] = tick
         if wal:
             from zkstream_tpu.server.persist import scrape_wal_cells
             out['wal_stats'] = scrape_wal_cells(collector)
@@ -1100,6 +1110,101 @@ def bench_wal() -> None:
             }), flush=True)
 
 
+#: `bench.py --traceov` fleet sizes (the acceptance envelope: the
+#: server trace plane — member span rings + tick ledger — must not be
+#: significantly slower than the untraced arm at either scale).
+TRACE_SCALES = (16, 64)
+
+
+def bench_trace_overhead() -> None:
+    """The server trace plane's cost envelope (`make bench-trace`):
+    paired write-heavy cells — trace plane on (the default: member
+    span rings + tick ledger) vs ``ZKSTREAM_NO_SERVER_TRACE=1`` — at
+    fleet 16/64.  Per-round adjacent A/B runs with the arm order
+    ALTERNATING per round: on this image the first cell of an
+    adjacent pair runs measurably slower regardless of arm (observed
+    ~10-15 % first-slot penalty over a 4-round A/A probe), and a
+    fixed order folds that bias straight into the sign test.  Sign of
+    the per-round headline (set ops/s) delta, exact two-sided sign
+    test: otherwise the same PROFILE.md methodology as the cork, WAL
+    and fan-out families."""
+    import asyncio
+
+    from zkstream_tpu.utils import native
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    mode = 'native' if native.ensure_lib() is not None else 'python'
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_TRACE_ROUNDS', '10'))
+    # the arms toggle the env var the server reads at construction;
+    # snapshot and restore any operator-set value, and force BOTH
+    # states explicitly — an inherited ZKSTREAM_NO_SERVER_TRACE=1
+    # would otherwise turn the traced arm into a second untraced one
+    ambient = os.environ.get('ZKSTREAM_NO_SERVER_TRACE')
+    rows: dict = {}
+    cells: dict = {}
+    try:
+        for rnd in range(rounds):
+            arms = (('traced', 'untraced') if rnd % 2 == 0
+                    else ('untraced', 'traced'))
+            for n in TRACE_SCALES:
+                # the sign test pairs ADJACENT A/B runs: a round where
+                # either arm failed contributes to neither, so the
+                # surviving pairs stay aligned round-for-round (the
+                # fan-out family's rule)
+                pair: dict = {}
+                for arm in arms:
+                    if arm == 'untraced':
+                        os.environ['ZKSTREAM_NO_SERVER_TRACE'] = '1'
+                    else:
+                        os.environ.pop('ZKSTREAM_NO_SERVER_TRACE',
+                                       None)
+                    try:
+                        r = asyncio.run(_client_ops_run(
+                            mode, n, write_heavy=True))
+                    except Exception as e:
+                        print('# trace cell %s@%d round failed: %r'
+                              % (arm, n, e), file=sys.stderr)
+                        continue
+                    r['trace_arm'] = arm
+                    pair[arm] = r
+                for arm, r in pair.items():
+                    key = (n, arm)
+                    if len(pair) == 2:
+                        rows.setdefault(key, []).append(
+                            r['set']['ops_per_sec'])
+                    if key not in cells or r['set']['ops_per_sec'] > \
+                            cells[key]['set']['ops_per_sec']:
+                        cells[key] = r
+    finally:
+        if ambient is None:
+            os.environ.pop('ZKSTREAM_NO_SERVER_TRACE', None)
+        else:
+            os.environ['ZKSTREAM_NO_SERVER_TRACE'] = ambient
+    for key in sorted(cells, key=str):
+        print('# trace_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for n in TRACE_SCALES:
+        a = rows.get((n, 'traced'), [])
+        b = rows.get((n, 'untraced'), [])
+        if not a or not b:
+            continue
+        paired = list(zip(a, b))
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired if x > y)
+        losses = sum(1 for x, y in paired if x < y)
+        print(json.dumps({
+            'metric': 'trace_plane_sign_test',
+            'pair': 'traced-vs-untraced',
+            'conns': n,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --fanout` sweep (the serving-plane cell family): sessions
 #: on the box x watchers on the hot path.  -1 = every session watches.
 FANOUT_SESSIONS = (1000, 10000, 100000)
@@ -1203,6 +1308,8 @@ async def fanout_cell(sessions: int, watchers: int, table: bool,
                 db.remove_all_listeners(evt)
         for c in conns:
             c.close()
+        if srv.ledger is not None:
+            srv.ledger.close_tick()   # flush the residual burst
     p50, p99 = _percentiles(lat_ms)
     out = {'sessions': sessions, 'watchers': watchers,
            'table': table, 'events': events,
@@ -1227,6 +1334,11 @@ async def fanout_cell(sessions: int, watchers: int, table: bool,
         flush = scrape_flush_cells(collector).get('fanout')
         if flush:
             out['fanout_flush_batches'] = flush
+    if collector is not None:
+        from zkstream_tpu.utils.metrics import scrape_tick_cells
+        tick = scrape_tick_cells(collector)
+        if tick:
+            out['tick_ledger'] = tick
     return out
 
 
@@ -1388,6 +1500,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_wal()
+        return
+    if '--traceov' in sys.argv:
+        # `make bench-trace`: the paired trace-plane overhead family
+        # (server span rings + tick ledger vs
+        # ZKSTREAM_NO_SERVER_TRACE=1).  Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_trace_overhead()
         return
     if '--fanout' in sys.argv:
         # `make bench-fanout`: the serving-plane fan-out cell family

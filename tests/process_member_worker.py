@@ -46,7 +46,9 @@ async def run_leader(wal_dir: str | None = None,
         db = open_wal_database(wal_dir, sync=sync)
     else:
         db = ZKDatabase()
-    member = await ZKServer(db).start()
+    # member id 'leader': what the trce admin word / merged causal
+    # timeline names this process's span ring by
+    member = await ZKServer(db, member='leader').start()
     repl = await ReplicationService(db).start()
     print('READY %d %d' % (member.port, repl.port), flush=True)
     await asyncio.Event().wait()
@@ -100,7 +102,10 @@ async def run_follower(leader_host: str, leader_port: int,
             remote.wal = wal
         if not remote.resynced:
             wal.snapshot_now()
-    member = await ZKServer(remote, store=store).start()
+    # pid-qualified member id: two followers of one ensemble must not
+    # collapse into one source in the merged timeline
+    member = await ZKServer(remote, store=store,
+                            member='follower-%d' % os.getpid()).start()
     print('READY %d' % (member.port,), flush=True)
     await asyncio.Event().wait()
 

@@ -116,6 +116,76 @@ async def test_admin_probe_does_not_disturb_protocol_clients(server):
         await c.close()
 
 
+async def test_mntr_tick_ledger_and_trace_rows(server):
+    """The tick-ledger rows (zk_tick_count, per-phase p99) and the
+    trace-ring overwrite counter ride mntr: after real traffic the
+    counts are live and the decode phase has a distribution."""
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/t', b'x')
+        for i in range(5):
+            await c.set('/t', b'v%d' % i)
+        text = (await _four_letter(server, b'mntr')).decode()
+        kv = dict(line.split('\t', 1)
+                  for line in text.strip().splitlines())
+        assert int(kv['zk_tick_count']) > 0
+        assert int(kv['zk_trace_ring_dropped']) == 0
+        assert float(kv['zk_tick_phase_ms_p99{phase="decode_apply"}'
+                        ]) >= 0.0
+        assert float(kv['zk_tick_phase_ms_p99{phase="cork_flush"}'
+                        ]) >= 0.0
+    finally:
+        await c.close()
+
+
+async def test_trce_word_dumps_member_ring(server):
+    """trce: the member's span ring as trace_schema-stamped JSON —
+    what `timeline --live` merges across members."""
+    import json
+
+    from zkstream_tpu.utils.trace import TRACE_SCHEMA
+
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/t', b'x')
+        await c.set('/t', b'y')
+        dump = json.loads(await _four_letter(server, b'trce'))
+        assert dump['trace_schema'] == TRACE_SCHEMA
+        assert dump['member'] == server.member
+        assert dump['dropped'] == 0
+        ops = [s['op'] for s in dump['spans']]
+        assert 'COMMIT' in ops and 'SRV_DECODE' in ops
+        commits = [s for s in dump['spans'] if s['op'] == 'COMMIT']
+        assert all(s['zxid'] for s in commits)
+    finally:
+        await c.close()
+
+
+async def test_trce_word_with_trace_disabled():
+    """A server with the trace plane off still answers trce (empty
+    ring) — scrapes must not error on an untraced member."""
+    import json
+
+    from zkstream_tpu.server import ZKServer
+
+    srv = await ZKServer(trace=False).start()
+    try:
+        assert srv.trace is None and srv.ledger is None
+        dump = json.loads(await _four_letter(srv, b'trce'))
+        assert dump['spans'] == [] and dump['dropped'] == 0
+        # and mntr omits the ledger rows rather than lying
+        text = (await _four_letter(srv, b'mntr')).decode()
+        assert 'zk_tick_count' not in text
+    finally:
+        await srv.stop()
+
+
 async def test_mntr_follower_mode_in_ensemble():
     from zkstream_tpu.server import ZKEnsemble
 

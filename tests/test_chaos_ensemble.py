@@ -259,8 +259,23 @@ def test_format_history_renders_member_timeline():
 
 def _assert_clean_scrape(collector: Collector, result) -> None:
     """Satellite: after a campaign the FSM census must hold no leaked
-    transitional states, and the degraded gauge must be consistent
-    (reconnected-before-close schedules end not-degraded)."""
+    transitional states, the degraded gauge must be consistent
+    (reconnected-before-close schedules end not-degraded), and every
+    trace span — client and member rings alike — must be settled (an
+    op evicted from the pending table without a settle finishes
+    'abandoned', never stays 'open')."""
+    leaked_spans = [s for s in result.trace if s['status'] == 'open']
+    assert not leaked_spans, \
+        'seed %d left %d open client span(s): %r' \
+        % (result.seed, len(leaked_spans), leaked_spans[:4])
+    assert result.member_rings, \
+        'seed %d: member rings missing from result' % (result.seed,)
+    for name, spans in result.member_rings.items():
+        leaked_spans = [s for s in spans if s['status'] == 'open']
+        assert not leaked_spans, \
+            'seed %d left %d open span(s) on %s: %r' \
+            % (result.seed, len(leaked_spans), name,
+               leaked_spans[:4])
     text = collector.expose()
     for fsm, states in (
             ('ZKConnection', ('connecting', 'handshaking',
@@ -509,6 +524,15 @@ def test_chaos_ensemble_cli_rerun_and_trace(tmp_path):
     assert all(d['tier'] == 'ensemble' for d in dumps)
     assert all('member_events' in d and 'history' in d
                for d in dumps)
+    # schema-2 payload: stamped, member rings per member, merged
+    # zxid-ordered timeline
+    assert all(d['trace_schema'] == 2 for d in dumps)
+    assert all(len(d['member_rings']) == 3 for d in dumps)
+    assert any(s['op'] == 'APPLY'
+               for d in dumps
+               for spans in d['member_rings'].values()
+               for s in spans)
+    assert all(isinstance(d['timeline'], list) for d in dumps)
     # member kill/restart events ride the span ring too
     kinds = {s.get('kind') for d in dumps for s in d['trace']}
     events = [e for d in dumps for e in d['member_events']]

@@ -321,3 +321,60 @@ async def test_cli_main_entry_via_subprocess(server):
             capture_output=True, text=True, timeout=120, cwd=REPO))
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert out.stdout.startswith('ping ok:')
+
+
+# -- timeline: the causal-tracing demo + live trce scrape --------------
+
+async def test_cli_timeline_demo_text_and_json(capsys):
+    """`zkstream_tpu timeline`: the self-contained in-process demo
+    renders the merged causal chain for one watched write — client
+    submit, leader commit + WAL append + group fsync, follower
+    applies, fan-out delivery — and --json emits the schema-stamped
+    rings + timeline."""
+    import json
+
+    args = cli.build_parser().parse_args(['timeline'])
+    rc = await cli._timeline(args)
+    out, _err = capsys.readouterr()
+    assert rc == 0
+    for op in ('SET_DATA', 'COMMIT', 'WAL_APPEND', 'GROUP_FSYNC',
+               'APPLY', 'FANOUT'):
+        assert op in out, out
+    assert 'member:1' in out and 'member:2' in out
+
+    args = cli.build_parser().parse_args(['timeline', '--json'])
+    rc = await cli._timeline(args)
+    out, _err = capsys.readouterr()
+    assert rc == 0
+    dump = json.loads(out)
+    assert dump['trace_schema'] == 2
+    assert set(dump['rings']) >= {'client', 'member:0', 'member:1'}
+    assert any(e['op'] == 'GROUP_FSYNC' for e in dump['timeline'])
+
+
+async def test_cli_timeline_live_scrapes_members(capsys):
+    """`timeline --live` scrapes the trce rings of a running ensemble
+    (no demo, no protocol session) and merges whatever they hold."""
+    from zkstream_tpu.server import ZKEnsemble
+
+    ens = await ZKEnsemble(2).start()
+    c = Client(servers=[{'address': h, 'port': p}
+                        for h, p in ens.addresses()],
+               shuffle_backends=False, session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/live', b'x')
+        await c.set('/live', b'y')
+        spec = ','.join('127.0.0.1:%d' % p
+                        for _h, p in ens.addresses())
+        args = cli.build_parser().parse_args(
+            ['--server', spec, 'timeline', '--live'])
+        rc = await cli._timeline(args)
+        out, _err = capsys.readouterr()
+        assert rc == 0
+        assert 'COMMIT' in out and '/live' in out
+        assert 'member:1' in out and 'APPLY' in out
+    finally:
+        await c.close()
+        await ens.stop()

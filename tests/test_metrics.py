@@ -294,3 +294,117 @@ def test_histogram_percentile_interpolation():
     assert h3.percentile(50, {'op': 'GET'}) <= 1.0
     assert h3.percentile(50, {'op': 'SET'}) > 1.0
     assert {dict(k)['op'] for k in h3.label_keys()} == {'GET', 'SET'}
+
+
+# -- the tick ledger (utils/metrics.TickLedger) ------------------------
+
+def test_tick_ledger_nested_phases_subtract():
+    """A nested section's time is counted once (in the inner phase),
+    and phase sums can never exceed the tick's wall span."""
+    import time
+
+    from zkstream_tpu.utils.metrics import TickLedger
+
+    led = TickLedger()
+    led.enter('decode_apply')
+    time.sleep(0.002)
+    led.enter('fsync_gate')          # e.g. sync='always' inside append
+    time.sleep(0.002)
+    led.exit()
+    time.sleep(0.001)
+    led.exit()
+    led.close_tick()                 # no loop: manual close
+    assert led.ticks == 1
+    tick = led.last_tick
+    phases = tick['phases']
+    assert set(phases) == {'decode_apply', 'fsync_gate'}
+    assert phases['fsync_gate'] >= 1.5
+    # the parent's accumulation excludes the nested child
+    assert phases['decode_apply'] >= 2.5
+    total = sum(phases.values())
+    assert total <= tick['total_ms'] + 1e-6
+    # and in this gap-free synchronous drive, sums to it (slop for
+    # the enter/exit bookkeeping itself)
+    assert tick['total_ms'] - total < 1.0
+
+
+def test_tick_ledger_phase_p99_and_scrape():
+    from zkstream_tpu.utils.metrics import (
+        Collector,
+        TickLedger,
+        scrape_tick_cells,
+    )
+
+    col = Collector()
+    led = TickLedger(col)
+    for _ in range(4):
+        led.enter('cork_flush')
+        led.exit()
+        led.close_tick()
+    assert led.ticks == 4
+    assert led.phase_p99('cork_flush') is not None
+    assert led.phase_p99('fanout_flush') is None
+    cells = scrape_tick_cells(col)
+    assert cells['ticks'] == 4
+    assert 'cork_flush' in cells['phases']
+    ph = cells['phases']['cork_flush']
+    assert ph['count'] == 4
+    assert 0.0 <= ph['share'] <= 1.0
+
+
+async def test_tick_ledger_coalesces_spilled_callbacks():
+    """call_soon callbacks scheduled during a tick's processing run in
+    the NEXT loop iteration (the cork/fan-out flushes of one logical
+    tick): the close callback re-arms while activity continues, so
+    the whole burst lands in ONE ledger tick."""
+    import asyncio
+
+    from zkstream_tpu.utils.metrics import TickLedger
+
+    led = TickLedger()
+    loop = asyncio.get_running_loop()
+
+    def flush():                     # the spill-over callback
+        led.enter('cork_flush')
+        led.exit()
+
+    led.enter('decode_apply')
+    loop.call_soon(flush)            # scheduled mid-tick
+    led.exit()
+    for _ in range(4):               # let the burst + close drain
+        await asyncio.sleep(0)
+    assert led.ticks == 1
+    assert set(led.last_tick['phases']) == {'decode_apply',
+                                            'cork_flush'}
+
+
+async def test_tick_ledger_sums_to_busy_tick_on_live_server(server):
+    """Acceptance: the phase histograms sum (within slop) to the
+    observed busy-tick duration on a real server under a pipelined
+    write burst."""
+    from zkstream_tpu import Client
+    from zkstream_tpu.utils.metrics import METRIC_TICK_PHASE
+
+    c = Client(address='127.0.0.1', port=server.port,
+               session_timeout=5000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=5)
+        await c.create('/t', b'x')
+        for i in range(20):
+            await c.set('/t', b'v%d' % i)
+    finally:
+        await c.close()
+    led = server.ledger
+    assert led is not None and led.ticks > 0
+    phase_total = sum(
+        led.phase_hist.sum(dict(k))
+        for k in led.phase_hist.label_keys())
+    tick_total = led.tick_hist.sum()
+    assert led.phase_hist.name == METRIC_TICK_PHASE
+    # phases are exclusive slices of each tick's [first, last] window
+    assert phase_total <= tick_total + 1e-6
+    # and cover most of it (the gap is un-instrumented loop work;
+    # generous slop for a loaded CI core)
+    assert phase_total >= 0.25 * tick_total, \
+        (phase_total, tick_total)

@@ -382,3 +382,76 @@ async def test_rolling_sigkill_chaos_soak(process_ensemble):
                 m.proc.kill()
             m.proc.wait()
             m.proc.stdout.close()
+
+
+async def _scrape_trce(port: int) -> dict:
+    import json
+
+    reader, writer = await asyncio.open_connection('127.0.0.1', port)
+    try:
+        writer.write(b'trce')
+        await writer.drain()
+        return json.loads(await asyncio.wait_for(reader.read(), 5))
+    finally:
+        writer.close()
+
+
+async def test_trce_scrape_merges_cross_process_timeline(
+        process_ensemble):
+    """Acceptance (OS-process tier): a watched write through a
+    follower process leaves a zxid-keyed span chain spanning real
+    processes — client submit, leader commit + replication push,
+    follower apply, fan-out delivery — reassembled by scraping every
+    member's `trce` admin word over raw TCP and merging by zxid."""
+    from zkstream_tpu.utils.trace import (
+        format_timeline,
+        merge_timelines,
+    )
+
+    leader, (f1, f2) = process_ensemble
+    c = _client([('127.0.0.1', f1.ports[0])])
+    try:
+        await c.wait_connected(timeout=10)
+        await c.create('/xproc', b'v0')
+
+        fires: list = []
+        fired = asyncio.get_running_loop().create_future()
+
+        def on_change(*a):
+            fires.append(a)
+            if len(fires) >= 2 and not fired.done():
+                fired.set_result(None)
+        c.watcher('/xproc').on('dataChanged', on_change)
+        await asyncio.sleep(0.3)      # armed; arm-time emit delivered
+        stat = await c.set('/xproc', b'v1')
+        zxid = stat.mzxid
+        await asyncio.wait_for(fired, 10)
+        await c.sync('/xproc')
+
+        rings = {'client': c.trace.dump()}
+        for port in (leader.ports[0], f1.ports[0], f2.ports[0]):
+            dump = await _scrape_trce(port)
+            assert dump['trace_schema'] == 2
+            rings['member:%s' % (dump['member'],)] = dump['spans']
+        merged = merge_timelines(rings)
+        sel = [(e['source'], e['op']) for e in merged
+               if e['zxid'] == zxid]
+        assert ('client', 'SET_DATA') in sel, sel
+        assert ('member:leader', 'COMMIT') in sel, sel
+        assert any(src == 'member:leader' and op == 'REPL_PUSH'
+                   for src, op in sel), sel
+        appliers = {src for src, op in sel
+                    if op == 'APPLY'
+                    and src.startswith('member:follower-')}
+        assert len(appliers) == 2, sel   # both follower processes
+        assert any(op == 'FANOUT'
+                   and src.startswith('member:follower-')
+                   for src, op in sel), sel
+        # causal order within the zxid group: submit before commit
+        # before push before any apply
+        ops = [op for _src, op in sel]
+        assert ops.index('SET_DATA') < ops.index('COMMIT') \
+            < ops.index('REPL_PUSH') < ops.index('APPLY')
+        assert format_timeline(merged)
+    finally:
+        await c.close()

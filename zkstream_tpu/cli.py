@@ -134,8 +134,13 @@ async def _dispatch(client: Client, args) -> int:
     elif cmd == 'stat':
         _print_stat(await client.stat(args.path))
     elif cmd == 'getacl':
+        from .protocol.consts import Perm
         for acl in await client.get_acl(args.path):
-            perms = '|'.join(sorted(p.name for p in acl.perms))
+            # iterate the enum, not the flag value: Flag-member
+            # iteration only exists on Python >= 3.11
+            perms = '|'.join(sorted(
+                p.name for p in Perm
+                if p is not Perm.ALL and p in acl.perms))
             print('%s:%s = %s' % (acl.id.scheme, acl.id.id, perms))
     elif cmd == 'create':
         flags = CreateFlag(0)
@@ -283,8 +288,27 @@ def build_parser() -> argparse.ArgumentParser:
         help='scrape a live server with a ZooKeeper four-letter '
              'admin word (raw TCP, no session)')
     mn.add_argument('word', nargs='?', default='mntr',
-                    choices=('mntr', 'ruok', 'stat', 'srvr'),
-                    help='which admin word to send (default mntr)')
+                    choices=('mntr', 'ruok', 'stat', 'srvr', 'trce'),
+                    help='which admin word to send (default mntr; '
+                         'trce dumps the member span ring as JSON)')
+
+    tl = sub.add_parser(
+        'timeline',
+        help='render a merged zxid-ordered causal timeline: one '
+             'traced write followed across client, leader (commit, '
+             'WAL append, shared group-fsync span), followers '
+             '(apply) and watch fan-out delivery.  Default: run a '
+             'self-contained in-process ensemble demo; --live '
+             'scrapes the member rings of the --server list (trce '
+             'admin word) instead')
+    tl.add_argument('--live', action='store_true',
+                    help='scrape live members (--server) rather than '
+                         'running the in-process demo')
+    tl.add_argument('--members', type=int, default=3,
+                    help='demo ensemble size (default 3)')
+    tl.add_argument('--json', dest='as_json', action='store_true',
+                    help='emit trace_schema-stamped JSON (rings + '
+                         'merged timeline) instead of text')
 
     sub.add_parser(
         'metrics',
@@ -391,7 +415,12 @@ async def _chaos(args) -> int:
     visible without log grepping."""
     from .io.faults import run_campaign, run_ensemble_campaign
     from .io.invariants import format_history
-    from .utils.trace import format_spans
+    from .utils.trace import (
+        TRACE_SCHEMA,
+        format_spans,
+        format_timeline,
+        merge_timelines,
+    )
 
     if getattr(args, 'no_watchtable', False):
         # the schedule servers resolve their dispatch path from the
@@ -418,6 +447,16 @@ async def _chaos(args) -> int:
         if not r.ok and r.trace:
             print('  span ring (oldest first):')
             print(format_spans(r.trace))
+        if not r.ok and (r.trace or r.member_rings):
+            # the cross-member view: client + member rings merged by
+            # zxid, so the violated write's full causal path (commit,
+            # fsync barrier, replication, follower apply, fan-out) is
+            # on screen next to the seed
+            merged = merge_timelines(
+                dict({'client': r.trace}, **r.member_rings))
+            if merged:
+                print('  merged causal timeline (zxid order):')
+                print(format_timeline(merged, limit=60))
 
     if args.tier == 'ensemble':
         results = await run_ensemble_campaign(
@@ -434,11 +473,19 @@ async def _chaos(args) -> int:
         with open(args.trace_out, 'w') as f:
             # member kill/restart events ride the span ring (kind
             # 'member') AND the structured history; bytes payloads in
-            # history records serialize via repr
-            json.dump([{'seed': r.seed, 'ok': r.ok, 'tier': r.tier,
+            # history records serialize via repr.  Each schedule is
+            # schema-stamped and carries every member's server-side
+            # ring plus the merged zxid-ordered timeline.
+            json.dump([{'trace_schema': TRACE_SCHEMA,
+                        'seed': r.seed, 'ok': r.ok, 'tier': r.tier,
                         'violations': r.violations,
                         'member_events': r.member_events,
-                        'trace': r.trace, 'history': r.history}
+                        'trace': r.trace,
+                        'member_rings': r.member_rings,
+                        'timeline': merge_timelines(
+                            dict({'client': r.trace},
+                                 **r.member_rings)),
+                        'history': r.history}
                        for r in results], f, indent=2, default=repr)
         print('span dumps written to %s' % (args.trace_out,))
     bad = [r for r in results if not r.ok]
@@ -455,6 +502,105 @@ async def _chaos(args) -> int:
               file=sys.stderr)
         return 1
     return 0
+
+
+async def _timeline(args) -> int:
+    """The causal-timeline renderer.  Demo mode runs a 3-member
+    in-process ensemble (WAL on, watch armed), performs one traced
+    write, and prints the merged client+member timeline — the span
+    chain README "Causal tracing" documents.  ``--live`` scrapes the
+    ``trce`` admin word from every --server member (an OS-process
+    ensemble included) and merges whatever rings they hold."""
+    import json as _json
+
+    from .utils.trace import (
+        TRACE_SCHEMA,
+        format_timeline,
+        merge_timelines,
+    )
+
+    if args.live:
+        rings: dict = {}
+        failed = 0
+        for spec in args.server:
+            host, port = spec['address'], spec['port']
+            try:
+                raw = await _admin_one(host, port, 'trce',
+                                       args.timeout)
+                dump = _json.loads(raw.decode('utf-8'))
+            except (OSError, ValueError, asyncio.TimeoutError,
+                    TimeoutError):
+                print('error: could not scrape trce from %s:%d'
+                      % (host, port), file=sys.stderr)
+                failed += 1
+                continue
+            key = 'member:%s' % (dump.get('member', port),)
+            if key in rings:
+                # two members reporting the same id (e.g. two
+                # standalone servers, both default '0'): qualify by
+                # address rather than silently overwriting one ring
+                key = 'member:%s@%s:%d' % (dump.get('member', port),
+                                           host, port)
+            rings[key] = dump.get('spans', [])
+        if failed and not rings:
+            return 1
+        merged = merge_timelines(rings)
+        if args.as_json:
+            print(_json.dumps({'trace_schema': TRACE_SCHEMA,
+                               'rings': rings, 'timeline': merged},
+                              indent=2))
+        else:
+            print(format_timeline(merged) or '(no zxid-keyed spans)')
+        return 1 if failed else 0
+
+    # -- demo: in-process ensemble, one write, full span chain --------
+    import shutil
+    import tempfile
+
+    from .server.server import ZKEnsemble
+
+    loop = asyncio.get_running_loop()
+    wal_dir = tempfile.mkdtemp(prefix='zktimeline-wal-')
+    ens = await ZKEnsemble(max(2, args.members),
+                           wal_dir=wal_dir).start()
+    client = Client(servers=[{'address': h, 'port': p}
+                             for h, p in ens.addresses()],
+                    shuffle_backends=False)
+    client.start()
+    try:
+        await client.wait_connected(timeout=10)
+        await client.create('/demo', b'v0')
+        fires: list = []
+        fired = loop.create_future()
+
+        def on_change(*a):
+            fires.append(a)
+            if len(fires) >= 2 and not fired.done():
+                fired.set_result(None)   # arm-time emit + the real one
+        client.watcher('/demo').on('dataChanged', on_change)
+        await asyncio.sleep(0.2)         # watch armed, arm-emit in
+        await client.set('/demo', b'v1')
+        await asyncio.wait_for(fired, 10)
+        await client.sync('/demo')       # drain fan-out + fsync legs
+        await asyncio.sleep(0.05)
+        rings = {'client': client.trace.dump()}
+        for s in ens.servers:
+            if s.trace is not None:
+                rings['member:%s' % (s.member,)] = s.trace.dump()
+        merged = merge_timelines(rings)
+        if args.as_json:
+            print(_json.dumps({'trace_schema': TRACE_SCHEMA,
+                               'rings': rings, 'timeline': merged},
+                              indent=2))
+        else:
+            print('causal timeline for one create + one watched set '
+                  '(%d members, WAL on):' % (len(ens.servers),))
+            print(format_timeline(merged))
+        return 0
+    finally:
+        await client.close()
+        await ens.stop()
+        shutil.rmtree(wal_dir, ignore_errors=True)
 
 
 def _wal(args) -> int:
@@ -538,6 +684,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == 'mntr':
         # raw four-letter-word scrape: no client, no session
         return asyncio.run(_admin(args))
+    if args.cmd == 'timeline':
+        # self-contained demo (or raw trce scrape with --live):
+        # never dials --server as a protocol client
+        return asyncio.run(_timeline(args))
     return asyncio.run(_run(args))
 
 

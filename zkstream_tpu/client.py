@@ -419,9 +419,21 @@ class Client(FSM):
         """Send one traced request: the span is created before the
         write, correlated by the xid the connection assigns, and closed
         by the connection's reply/error routing (io/connection.py) with
-        the reply zxid stamped on."""
+        the reply zxid stamped on.
+
+        A request that never makes it into the pending table (the
+        connection died between the liveness check and the send) must
+        not leave its span open — the ring would report a phantom
+        in-flight op forever; it settles as ``abandoned`` and the
+        error propagates."""
         span = self.trace.start(pkt['opcode'], pkt.get('path'))
-        req = conn.request(pkt)
+        try:
+            req = conn.request(pkt)
+        except BaseException as e:
+            span.finish(status='abandoned',
+                        error=getattr(e, 'code', None)
+                        or type(e).__name__)
+            raise
         span.xid = pkt['xid']
         span.backend = conn.backend.key
         if conn.session is not None:
@@ -479,7 +491,14 @@ class Client(FSM):
             else:
                 span.finish()
                 fut.set_result(latency)
-        conn.ping(cb)
+        try:
+            conn.ping(cb)
+        except BaseException as e:
+            # never sent: settle the span (see _start_op)
+            span.finish(status='abandoned',
+                        error=getattr(e, 'code', None)
+                        or type(e).__name__)
+            raise
         return await self._await_op(fut, 'PING', None, deadline, span)
 
     async def list(self, path: str,

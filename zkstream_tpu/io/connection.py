@@ -516,10 +516,15 @@ class ZKConnection(FSM):
             self.emit('close')
             # Fail any remaining outstanding requests or they would hang
             # forever (reference: lib/connection-fsm.js:338-350).
+            # Their spans settle as 'abandoned': the op was evicted
+            # from the pending table without a reply ever routing —
+            # distinct from a request that saw a typed error — so the
+            # ring can never hold an open span after teardown (the
+            # chaos campaigns assert exactly that).
             err = ZKProtocolError('CONNECTION_LOSS', 'Connection closed.')
             reqs, self.reqs = self.reqs, {}
             for req in reqs.values():
-                _finish_span(req, status='error', error=err.code)
+                _finish_span(req, status='abandoned', error=err.code)
                 req.emit('error', err)
         S.immediate(fail_stragglers)
 

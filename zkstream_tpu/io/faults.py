@@ -534,6 +534,20 @@ async def _bounded_op(res: 'ScheduleResult', coro, what: str,
         return False, None
 
 
+def _note_open_spans(res: 'ScheduleResult', trace) -> None:
+    """Teardown invariant shared by both campaign tiers: every span
+    must be settled once the client is closed — an op evicted from the
+    pending table without a settle is a span-leak bug (abandoned ops
+    finish status='abandoned', never stay 'open')."""
+    leaked = trace.open_spans()
+    if leaked:
+        res.violations.append(
+            '%d trace span(s) left open after teardown: %s'
+            % (len(leaked),
+               ', '.join('#%d %s' % (s.span_id, s.op)
+                         for s in leaked[:8])))
+
+
 @dataclasses.dataclass
 class ScheduleResult:
     seed: int
@@ -548,6 +562,11 @@ class ScheduleResult:
     #: after the schedule: on a violation this is the exact
     #: request/reply/notification interleaving that produced it.
     trace: list = dataclasses.field(default_factory=list)
+    #: Every member's server-side span ring ('member:N' -> dump):
+    #: merged with the client ring by zxid (utils/trace.
+    #: merge_timelines) this is the cross-member causal path of each
+    #: write — printed on failure, carried in ``chaos --trace-out``.
+    member_rings: dict = dataclasses.field(default_factory=dict)
     #: Which campaign tier produced this result ('transport' or
     #: 'ensemble').
     tier: str = 'transport'
@@ -782,8 +801,12 @@ async def run_schedule(seed: int, ops: int = 6,
         shutil.rmtree(wal_dir, ignore_errors=True)
         shutil.rmtree(crash_dir, ignore_errors=True)
         inj.close()
+        _note_open_spans(res, client.trace)
         # dump after teardown so close-phase errors are captured too
         res.trace = client.trace.dump()
+        if srv.trace is not None:
+            res.member_rings = {
+                'member:%s' % (srv.member,): srv.trace.dump()}
 
 
 async def run_campaign(base_seed: int, schedules: int,
@@ -1330,7 +1353,11 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             ingest.close()
         shutil.rmtree(wal_dir, ignore_errors=True)
         shutil.rmtree(crash_dir, ignore_errors=True)
+        _note_open_spans(res, client.trace)
         res.trace = client.trace.dump()
+        res.member_rings = {
+            'member:%s' % (s.member,): s.trace.dump()
+            for s in ens.servers if s.trace is not None}
         res.history = list(h.records)
         # derived, never dual-appended: the history's member records
         # ARE the timeline

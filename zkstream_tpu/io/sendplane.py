@@ -75,13 +75,17 @@ class SendPlane:
 
     __slots__ = ('_write', '_chunks', '_pending', '_scheduled',
                  'enabled', 'max_bytes', '_frames_hist', '_bytes_hist',
-                 '_labels', '_barrier')
+                 '_labels', '_barrier', '_ledger')
 
     def __init__(self, write, *, enabled: bool | None = None,
                  max_bytes: int = DEFAULT_MAX_CORK,
                  collector=None, plane: str = 'client',
-                 barrier=None):
+                 barrier=None, ledger=None):
         self._write = write
+        #: Optional utils/metrics.TickLedger (server planes): flush
+        #: time lands in the ``cork_flush`` tick phase, loop-blocking
+        #: barrier time in ``fsync_gate``.
+        self._ledger = ledger
         #: Optional durability barrier gating corked bytes
         #: (server/persist.py WriteAheadLog): the acks of one tick
         #: share one group fsync, and no ack byte reaches the sink
@@ -167,9 +171,20 @@ class SendPlane:
         :meth:`flush_hard`."""
         if not self._chunks:
             return
-        if self._barrier is not None and \
-                not self._barrier.gate_flush(self.flush_now):
-            return              # durability pending: released later
+        if self._barrier is not None:
+            led = self._ledger
+            if led is not None:
+                # the barrier may take the fsync inline (fast-device
+                # short-circuit): that is loop-blocked durability time
+                led.enter('fsync_gate')
+                try:
+                    clear = self._barrier.gate_flush(self.flush_now)
+                finally:
+                    led.exit()
+            else:
+                clear = self._barrier.gate_flush(self.flush_now)
+            if not clear:
+                return          # durability pending: released later
         self._write_out()
 
     def flush_hard(self) -> None:
@@ -177,7 +192,15 @@ class SendPlane:
         for paths where later writes must not overtake (fault-injected
         delivery, CLOSE_SESSION ahead of EOF, connection close)."""
         if self._barrier is not None:
-            self._barrier.sync_for_flush()
+            led = self._ledger
+            if led is not None:
+                led.enter('fsync_gate')
+                try:
+                    self._barrier.sync_for_flush()
+                finally:
+                    led.exit()
+            else:
+                self._barrier.sync_for_flush()
         self._write_out()
 
     def _write_out(self) -> None:
@@ -189,7 +212,16 @@ class SendPlane:
         self._chunks = []
         self._pending = 0
         self._observe(n, size)
-        self._write(chunks[0] if n == 1 else b''.join(chunks))
+        led = self._ledger
+        if led is not None:
+            led.enter('cork_flush')
+            try:
+                self._write(chunks[0] if n == 1
+                            else b''.join(chunks))
+            finally:
+                led.exit()
+        else:
+            self._write(chunks[0] if n == 1 else b''.join(chunks))
 
     def reset(self) -> None:
         """Drop corked frames without writing (connection aborted:

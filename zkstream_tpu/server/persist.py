@@ -565,6 +565,16 @@ class WriteAheadLog:
         #: sets "replica caught up to the mirror" here, so a fuzzy
         #: image can never stamp entries the tree hasn't applied).
         self.snapshot_gate = None
+        #: Optional utils/trace.TraceRing (the owning member's —
+        #: server/server.py wires it): every append records a
+        #: ``WAL_APPEND`` span and every completed fsync a
+        #: ``GROUP_FSYNC`` span stamped with the barrier's batch size,
+        #: so a txn's durability leg is traceable by zxid.
+        self.trace = None
+        #: Optional utils/metrics.TickLedger: loop-blocking sync time
+        #: (sync='always' appends, the tick-sync fast path) lands in
+        #: the ``fsync_gate`` tick phase.
+        self.ledger = None
         self._tree = None
         # counters (gauges read these; cheap ints, no hot-path cost)
         self.appends = 0
@@ -582,6 +592,10 @@ class WriteAheadLog:
         #: ``sync_errors``, demoted by the recovery invariant's
         #: floor) instead of wedging every reply forever
         self._attempted = 0
+        #: cumulative appends covered by completed fsyncs — the delta
+        #: at each fsync is that barrier's batch size (GROUP_FSYNC
+        #: span + the group-commit story in the timeline)
+        self._synced_appends = 0
         self._sync_scheduled = False
         self._inflight = False        # a group fsync is on the executor
         self._waiters: list = []      # send-plane releases awaiting it
@@ -741,8 +755,18 @@ class WriteAheadLog:
         self.last_zxid = entry_zxid(entry)
         if self._append_hist is not None:
             self._append_hist.observe(len(rec))
+        if self.trace is not None:
+            self.trace.note('WAL_APPEND', zxid=self.last_zxid,
+                            kind='server', nbytes=len(rec))
         if self.sync == 'always':
-            self.sync_now()
+            if self.ledger is not None:
+                self.ledger.enter('fsync_gate')
+                try:
+                    self.sync_now()
+                finally:
+                    self.ledger.exit()
+            else:
+                self.sync_now()
         elif self.sync == 'tick':
             self._schedule_tick_sync()
         else:
@@ -762,7 +786,17 @@ class WriteAheadLog:
 
     def _tick_sync(self) -> None:
         self._sync_scheduled = False
-        if not self._closed:
+        if self._closed:
+            return
+        if self.ledger is not None:
+            # the fast-device short-circuit fsyncs inline here: that
+            # is the tick's loop-blocked durability time
+            self.ledger.enter('fsync_gate')
+            try:
+                self._ensure_group_sync()
+            finally:
+                self.ledger.exit()
+        else:
             self._ensure_group_sync()
 
     # -- the ack gate (group commit riding the send-plane cork) --
@@ -816,6 +850,7 @@ class WriteAheadLog:
                          if self.faults is not None else (0.0, False))
         self._file.flush()
         snap_off, snap_zxid = self._written, self.last_zxid
+        snap_n = self.appends
         fd = self._file.fileno()
 
         def work() -> float:
@@ -833,10 +868,10 @@ class WriteAheadLog:
         fut = loop.run_in_executor(None, work)
         fut.add_done_callback(
             lambda f: self._group_sync_done(f, snap_off, snap_zxid,
-                                            gen))
+                                            gen, snap_n))
 
     def _group_sync_done(self, fut, snap_off: int, snap_zxid: int,
-                         gen: int) -> None:
+                         gen: int, snap_n: int = 0) -> None:
         self._inflight = False
         if gen != self._seg_gen:
             # the segment rolled while this fsync ran: roll's
@@ -857,7 +892,8 @@ class WriteAheadLog:
         exc = fut.exception()
         if exc is None:
             dur_ms = fut.result()
-            self._note_sync(dur_ms)
+            self._note_sync(dur_ms, snap_n=snap_n,
+                            snap_zxid=snap_zxid)
             if snap_off > self._durable:
                 self._durable = snap_off
                 self.durable_zxid = snap_zxid
@@ -871,10 +907,22 @@ class WriteAheadLog:
             # appends landed while the fsync ran: cover them too
             self._ensure_group_sync()
 
-    def _note_sync(self, dur_ms: float) -> None:
+    def _note_sync(self, dur_ms: float, snap_n: int = 0,
+                   snap_zxid: int = 0) -> None:
         self.fsyncs += 1
         if self._fsync_hist is not None:
             self._fsync_hist.observe(dur_ms)
+        if self.trace is not None and snap_n > self._synced_appends:
+            # ONE span for the whole barrier, shared by every txn it
+            # covered: stamped with the newest covered zxid and the
+            # batch size (the group-commit shape, visible per write
+            # in the merged timeline)
+            self.trace.note('GROUP_FSYNC', zxid=snap_zxid,
+                            kind='server',
+                            batch=snap_n - self._synced_appends,
+                            duration_ms=round(dur_ms, 3))
+        if snap_n > self._synced_appends:
+            self._synced_appends = snap_n
         self._sync_ewma_ms = (dur_ms if self._sync_ewma_ms is None
                               else 0.8 * self._sync_ewma_ms
                               + 0.2 * dur_ms)
@@ -909,6 +957,7 @@ class WriteAheadLog:
             return True
         t0 = time.perf_counter()
         snap_off, snap_zxid = self._written, self.last_zxid
+        snap_n = self.appends
         try:
             if self.faults is not None:
                 delay_ms, err = self.faults.fsync_fault()
@@ -925,7 +974,8 @@ class WriteAheadLog:
                         'zxid %d are not yet durable', e,
                         self.durable_zxid)
             return False
-        self._note_sync((time.perf_counter() - t0) * 1000.0)
+        self._note_sync((time.perf_counter() - t0) * 1000.0,
+                        snap_n=snap_n, snap_zxid=snap_zxid)
         self._attempted = max(self._attempted, snap_off)
         if snap_off > self._durable:
             self._durable = snap_off

@@ -50,6 +50,7 @@ import threading
 
 from ..protocol.consts import CreateFlag
 from ..utils.events import EventEmitter
+from .persist import entry_zxid
 from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 
 log = logging.getLogger('zkstream_tpu.server.replication')
@@ -188,11 +189,20 @@ class ReplicationService:
             pass
 
     def _push_commits(self) -> None:
+        trace = getattr(self.db, 'trace', None)
         for h in self._handles.values():
             base, entries = self._entries_from(h.shipped)
             if entries:
                 self._push(h, ('commit', base, entries))
                 h.shipped = base + len(entries)
+                if trace is not None:
+                    # one push span per follower, keyed by the newest
+                    # zxid shipped — the leader-side replication leg
+                    # of the merged timeline
+                    trace.note('REPL_PUSH',
+                               zxid=entry_zxid(entries[-1]),
+                               kind='server', batch=len(entries),
+                               detail=h.token[:8])
 
     def _push_expiry(self, session_id: int) -> None:
         for h in self._handles.values():

@@ -28,6 +28,8 @@ from ..protocol.errors import ZKProtocolError
 from ..io.sendplane import SendPlane
 from ..protocol.framing import PacketCodec
 from ..utils.aio import set_nodelay
+from ..utils.metrics import TickLedger
+from ..utils.trace import TRACE_SCHEMA, TraceRing, server_trace_default
 from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 from .watchtable import WatchTable, watchtable_default
 
@@ -35,7 +37,14 @@ log = logging.getLogger('zkstream_tpu.server')
 
 #: ZooKeeper four-letter admin words this server answers (raw bytes,
 #: no length prefix, sent as a connection's very first payload).
-ADMIN_WORDS = frozenset((b'ruok', b'mntr', b'stat', b'srvr'))
+#: ``trce`` is this stack's own: the member's span ring as JSON
+#: (trace_schema-stamped), so ``timeline --live`` can merge rings
+#: scraped from OS-process members.
+ADMIN_WORDS = frozenset((b'ruok', b'mntr', b'stat', b'srvr', b'trce'))
+
+#: Member span-ring capacity: deep enough to hold a campaign's recent
+#: window (decode + per-txn chain + fan-out), fixed memory.
+MEMBER_RING_CAPACITY = 512
 
 
 class ServerConnection:
@@ -83,7 +92,8 @@ class ServerConnection:
         #: never blocks on the device (server/persist.py sync='tick').
         self._tx = SendPlane(self._tx_write, enabled=server.cork,
                              collector=server.collector, plane='server',
-                             barrier=getattr(server.db, 'wal', None))
+                             barrier=getattr(server.db, 'wal', None),
+                             ledger=server.ledger)
 
     # -- wire helpers --
 
@@ -255,35 +265,56 @@ class ServerConnection:
                     # not an admin word: replay everything buffered
                     # through the normal codec path
                     data, self._admin_buf = self._admin_buf, b''
+                # the tick ledger's decode_apply phase covers the
+                # whole decode + dispatch burst (store apply and WAL
+                # append included; nested sync/flush phases subtract)
+                ledger = self.server.ledger
+                if ledger is not None:
+                    ledger.enter('decode_apply')
                 try:
-                    pkts = self.codec.decode(data)
-                except ZKProtocolError as e:
-                    log.debug('server: undecodable input: %s', e)
-                    break
-                # Outstanding accounting is batch-scoped: a pipelined
-                # read delivers N requests at once, and every one is
-                # outstanding until its handler replies.  (Handlers
-                # are synchronous today, so a concurrent mntr scrape
-                # observes nonzero only across a handler that awaits —
-                # e.g. via an injected fault gate — but the accounting
-                # stays correct if handlers ever grow await points.)
-                self.server.outstanding += len(pkts)
-                remaining = len(pkts)
-                try:
-                    for pkt in pkts:
-                        self.server.packets_received += 1
-                        if self.codec.handshaking:
-                            self._handle_connect(pkt)
-                        else:
-                            self._handle_request(pkt)
-                        self.server.outstanding -= 1
-                        remaining -= 1
-                        if self.closed:
-                            break
+                    try:
+                        pkts = self.codec.decode(data)
+                    except ZKProtocolError as e:
+                        log.debug('server: undecodable input: %s', e)
+                        break
+                    trace = self.server.trace
+                    if trace is not None and pkts and not (
+                            len(pkts) == 1
+                            and pkts[0].get('opcode') == 'PING'):
+                        # bare keepalive pings skip the ring: at fleet
+                        # scale they are most batches, and recording
+                        # them would wash the txn chains out of the
+                        # bounded window (and cost a span per ping)
+                        trace.note('SRV_DECODE', kind='server',
+                                   batch=len(pkts), nbytes=len(data))
+                    # Outstanding accounting is batch-scoped: a
+                    # pipelined read delivers N requests at once, and
+                    # every one is outstanding until its handler
+                    # replies.  (Handlers are synchronous today, so a
+                    # concurrent mntr scrape observes nonzero only
+                    # across a handler that awaits — e.g. via an
+                    # injected fault gate — but the accounting stays
+                    # correct if handlers ever grow await points.)
+                    self.server.outstanding += len(pkts)
+                    remaining = len(pkts)
+                    try:
+                        for pkt in pkts:
+                            self.server.packets_received += 1
+                            if self.codec.handshaking:
+                                self._handle_connect(pkt)
+                            else:
+                                self._handle_request(pkt)
+                            self.server.outstanding -= 1
+                            remaining -= 1
+                            if self.closed:
+                                break
+                    finally:
+                        # a close/raise mid-batch must still retire
+                        # the unhandled remainder from the gauge
+                        self.server.outstanding -= remaining
                 finally:
-                    # a close/raise mid-batch must still retire the
-                    # unhandled remainder from the gauge
-                    self.server.outstanding -= remaining
+                    if ledger is not None:
+                        ledger.exit()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -497,7 +528,9 @@ class ZKServer:
                  collector=None, durability: str | None = None,
                  wal_dir: str | None = None,
                  watchtable: bool | None = None,
-                 fanout_shards: int | None = None):
+                 fanout_shards: int | None = None,
+                 member: str | None = None,
+                 trace: bool | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -526,6 +559,37 @@ class ZKServer:
         self.store = store if store is not None else self.db
         self.host = host
         self.port = port
+        #: This member's id within its ensemble ('0' standalone /
+        #: leader; ZKEnsemble numbers its members) — the label every
+        #: span on this member's ring carries, and what the merged
+        #: timeline names it by.
+        self.member = member if member is not None else '0'
+        #: The server-side trace plane (utils/trace.py): this member's
+        #: bounded span ring plus the per-tick phase ledger
+        #: (utils/metrics.TickLedger).  None = process default
+        #: (``ZKSTREAM_NO_SERVER_TRACE=1`` disables), True/False
+        #: force — the A/B knob `bench.py --traceov` pairs on.
+        enabled_trace = (server_trace_default() if trace is None
+                         else trace)
+        self.trace = (TraceRing(MEMBER_RING_CAPACITY,
+                                member=self.member)
+                      if enabled_trace else None)
+        self.ledger = TickLedger(collector) if enabled_trace else None
+        if enabled_trace:
+            if self.store is self.db:
+                # leader/standalone member: the shared database's
+                # COMMIT spans, the WAL's append/fsync spans and its
+                # loop-blocking sync time all belong to this ring
+                self.db.trace = self.trace
+                wal = getattr(self.db, 'wal', None)
+                if wal is not None:
+                    wal.trace = self.trace
+                    wal.ledger = self.ledger
+            else:
+                # follower: the replica's APPLY spans land here (the
+                # RemoteReplicaStore of an OS-process follower
+                # included — same attribute)
+                self.store.trace = self.trace
         #: Outbound write coalescing for accepted connections
         #: (io/sendplane.py): None = process default, True/False force.
         self.cork = cork
@@ -708,6 +772,22 @@ class ZKServer:
             ('zk_wal_sync_errors', wal.sync_errors),
             ('zk_wal_snapshots', wal.snapshots_taken),
         ]
+        # the tick ledger + trace-ring rows (the per-tick plane
+        # decomposition, README "Causal tracing"): tick count, each
+        # phase's per-tick p99, and how often the bounded span ring
+        # wrapped
+        tick_rows: list[tuple[str, object]] = []
+        if self.trace is not None:
+            tick_rows.append(('zk_trace_ring_dropped',
+                              self.trace.dropped))
+        if self.ledger is not None:
+            tick_rows.append(('zk_tick_count', self.ledger.ticks))
+            for phase in TickLedger.PHASES:
+                p99 = self.ledger.phase_p99(phase)
+                if p99 is not None:
+                    tick_rows.append(
+                        ('zk_tick_phase_ms_p99{phase="%s"}' % (phase,),
+                         round(p99, 4)))
         return [
             ('zk_version', 'zkstream_tpu'),
             ('zk_server_state', self.mode()),
@@ -724,7 +804,7 @@ class ZKServer:
             ('zk_fanout_shards',
              0 if self.watch_table is None
              else self.watch_table.nshards),
-        ] + wal_rows
+        ] + tick_rows + wal_rows
 
     def admin_text(self, word: str) -> str:
         """Render one four-letter word's reply text."""
@@ -733,6 +813,19 @@ class ZKServer:
         if word == 'mntr':
             return ''.join('%s\t%s\n' % kv
                            for kv in self.monitor_stats())
+        if word == 'trce':
+            # this member's span ring as JSON — the scrape `timeline
+            # --live` merges across members (schema-stamped; an
+            # OS-process member answers it like any admin word)
+            import json
+            return json.dumps({
+                'trace_schema': TRACE_SCHEMA,
+                'member': self.member,
+                'dropped': (0 if self.trace is None
+                            else self.trace.dropped),
+                'spans': ([] if self.trace is None
+                          else self.trace.dump()),
+            }) + '\n'
         if word in ('stat', 'srvr'):
             lines = ['Zookeeper version: zkstream_tpu (in-process)']
             if word == 'stat':
@@ -796,7 +889,7 @@ class ZKEnsemble:
             ZKServer(self.db, host=host,
                      store=None if i == 0 else ReplicaStore(self.db,
                                                             lag=lag),
-                     watchtable=watchtable)
+                     watchtable=watchtable, member=str(i))
             for i in range(count)]
 
     def install_faults(self, injector) -> None:

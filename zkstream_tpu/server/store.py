@@ -37,6 +37,9 @@ import time
 
 from ..protocol.consts import CreateFlag
 from ..protocol.records import ACL, OPEN_ACL_UNSAFE, Stat
+# entry_zxid rides the traced commit/apply hot paths; persist.py
+# imports this module only lazily, so the top-level import is safe
+from .persist import entry_zxid
 from ..utils.events import EventEmitter
 from ..utils.aio import ambient_loop
 
@@ -118,6 +121,14 @@ class NodeTree(EventEmitter):
     ``zxid`` is the last transaction applied to THIS tree (== the
     leader's on a caught-up member, behind it on a lagging one).
     """
+
+    #: Optional utils/trace.TraceRing — the owning member's span ring
+    #: (server/server.py wires it): the leader database records a
+    #: ``COMMIT`` span per txn, a replica an ``APPLY`` span per
+    #: replayed entry, so a write's cross-member path is traceable by
+    #: zxid.  Class-level None keeps the no-tracing hot path a single
+    #: attribute test.
+    trace = None
 
     def __init__(self) -> None:
         super().__init__()
@@ -319,7 +330,6 @@ class ZKDatabase(NodeTree):
         ahead of this leader)."""
         if have_zxid < self.log_start_zxid or have_zxid > self.zxid:
             return None
-        from .persist import entry_zxid
         lo, hi = 0, len(self.log)
         while lo < hi:
             mid = (lo + hi) // 2
@@ -380,6 +390,10 @@ class ZKDatabase(NodeTree):
         reap_orphan_ephemerals(self)
 
     def _commit(self, entry: tuple) -> None:
+        if self.trace is not None:
+            self.trace.note('COMMIT', entry[1],
+                            zxid=entry_zxid(entry), kind='server',
+                            detail=entry[0])
         # durability first: the WAL append precedes the 'committed'
         # emit (and therefore every replica push and — because the
         # handler corks the ack after this returns — every ack byte)
@@ -405,7 +419,6 @@ class ZKDatabase(NodeTree):
         ensemble's memory without bound."""
         floor = min(r.applied for r in self._replicas)
         if floor - self.log_base >= self.LOG_TRUNC_CHUNK:
-            from .persist import entry_zxid
             self.log_start_zxid = entry_zxid(
                 self.log[floor - self.log_base - 1])
             del self.log[:floor - self.log_base]
@@ -616,6 +629,10 @@ class ReplicaStore(NodeTree):
 
     def _apply_one(self, entry: tuple) -> None:
         self.apply_entry(entry)
+        if self.trace is not None:
+            self.trace.note('APPLY', entry[1],
+                            zxid=entry_zxid(entry), kind='server',
+                            detail=entry[0])
 
     def catch_up(self) -> None:
         """Apply everything committed so far — what a write through

@@ -243,6 +243,16 @@ class WatchTable:
         data = self.encode(ntype, path, zxid)
         self.count -= len(subs)
         srv = self.server
+        trace = getattr(srv, 'trace', None)   # stub-server tolerant
+        if trace is not None:
+            # the fan-out leg of the zxid span chain: ONE span per
+            # store event, stamped with the watch count and the wire
+            # bytes it flushes (len(subs) subscribers x one shared
+            # encode)
+            trace.note('FANOUT', path, zxid=zxid, kind='server',
+                       batch=len(subs),
+                       nbytes=len(data) * len(subs),
+                       detail=ntype)
         if srv.faults is not None:
             # injection boundary: per frame, BEFORE the shard cork
             for conn in subs:
@@ -350,23 +360,32 @@ class WatchTable:
         honored (``flush_now`` gates on it)."""
         shard.scheduled = False
         dirty, shard.dirty = shard.dirty, []
+        ledger = getattr(self.server, 'ledger', None)
+        if ledger is not None:
+            # fanout_flush tick phase: the shard loop's own time (the
+            # nested send-plane writes account under cork_flush)
+            ledger.enter('fanout_flush')
         t0 = time.perf_counter()
         frames = 0
         nbytes = 0
-        for conn in dirty:
-            buf = conn._fanout_buf
-            if not buf:
-                continue
-            data = buf[0] if len(buf) == 1 else b''.join(buf)
-            frames += len(buf)
-            # the list object is reused across ticks (cleared in
-            # place): a 100k-subscriber flush must not allocate a
-            # fresh buffer per connection per event
-            buf.clear()
-            if conn.closed:
-                continue
-            nbytes += len(data)
-            conn._tx.send_flush(data)
+        try:
+            for conn in dirty:
+                buf = conn._fanout_buf
+                if not buf:
+                    continue
+                data = buf[0] if len(buf) == 1 else b''.join(buf)
+                frames += len(buf)
+                # the list object is reused across ticks (cleared in
+                # place): a 100k-subscriber flush must not allocate a
+                # fresh buffer per connection per event
+                buf.clear()
+                if conn.closed:
+                    continue
+                nbytes += len(data)
+                conn._tx.send_flush(data)
+        finally:
+            if ledger is not None:
+                ledger.exit()
         if frames and self._frames_hist is not None:
             labels = {'plane': 'fanout'}
             self._frames_hist.observe(frames, labels)

@@ -14,6 +14,10 @@ ride in a label without producing unparseable scrape output.
 
 from __future__ import annotations
 
+import time
+
+from .aio import ambient_loop
+
 
 def escape_label_value(value) -> str:
     """Escape a label value per the Prometheus text exposition format:
@@ -236,6 +240,200 @@ class Histogram:
             lines.append('%s_count%s %d' % (self.name,
                                             _render_labels(key), cum))
         return '\n'.join(lines)
+
+
+METRIC_TICK = 'zk_tick_ms'
+METRIC_TICK_PHASE = 'zk_tick_phase_ms'
+
+#: Tick/phase duration buckets, ms: a busy tick on this stack spans
+#: tens of microseconds (one pipelined reply) up to tens of
+#: milliseconds (a wide fan-out flush or a slow-device fsync).
+TICK_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 25.0, 50.0)
+
+
+class TickLedger:
+    """Per-busy-tick phase accounting for one server member.
+
+    The busy loop tick is the unit every plane already coalesces on —
+    one cork flush, one group fsync, one fan-out flush per tick — but
+    nothing said where the tick's wall time went.  The ledger splits
+    it: call sites bracket their work with :meth:`enter`/:meth:`exit`
+    (nested sections subtract cleanly, so a cork flush inside a shard
+    flush is counted once), and when the burst goes quiet the tick
+    closes — per-phase durations land in ``zk_tick_phase_ms{phase=}``
+    and the burst's wall span in ``zk_tick_ms``.
+
+    Phases (server/server.py wires them):
+
+    - ``decode_apply`` — request decode + handler dispatch (store
+      apply and WAL append included, minus nested phases);
+    - ``fsync_gate`` — loop-blocking durability-barrier time (the
+      inline fast-device fsync, ``sync='always'`` appends, the
+      synchronous barrier on close paths);
+    - ``cork_flush`` — send-plane buffer join + transport write;
+    - ``fanout_flush`` — the watch table's per-shard flush loop
+      (minus the nested cork writes it triggers).
+
+    A "tick" here is the whole burst: asyncio runs ``call_soon``
+    callbacks scheduled during a callback in the *next* loop
+    iteration, so the cork/fan-out flushes of one logical tick land
+    one iteration after the decode that corked them — the close
+    callback re-arms while activity continues and finalizes on the
+    first quiet iteration.  Phase sums are <= the tick wall span by
+    construction; the gap is un-instrumented loop work.
+
+    Works without a collector (mntr-only servers keep their own
+    histograms); with one, the same histograms are registered for
+    scraping (``scrape_tick_cells`` summarizes them per bench cell).
+    """
+
+    PHASES = ('decode_apply', 'fsync_gate', 'cork_flush',
+              'fanout_flush')
+
+    #: Close a still-active burst after this many loop iterations
+    #: anyway: under saturating back-to-back load every iteration has
+    #: new phase activity and a pure quiet-pass rule would never
+    #: close — the ledger then reports bounded burst slices (shares
+    #: stay exact; only the per-tick bucketing coarsens).
+    MAX_DEFERS = 8
+
+    __slots__ = ('ticks', 'phase_hist', 'tick_hist', 'last_tick',
+                 '_acc', '_stack', '_first', '_last', '_scheduled',
+                 '_gen', '_sched_gen', '_defers')
+
+    def __init__(self, collector=None):
+        self.ticks = 0
+        self.last_tick: dict | None = None
+        self._acc: dict[str, float] = {}
+        self._stack: list = []      # [phase, t0, child_seconds]
+        self._first = 0.0
+        self._last = 0.0
+        self._scheduled = False
+        self._gen = 0
+        self._sched_gen = -1
+        self._defers = 0
+        source = collector if collector is not None else Collector()
+        self.phase_hist = source.histogram(
+            METRIC_TICK_PHASE,
+            'Busy-tick time by phase, ms (decode_apply | fsync_gate '
+            '| cork_flush | fanout_flush)', buckets=TICK_BUCKETS)
+        self.tick_hist = source.histogram(
+            METRIC_TICK, 'Busy-tick wall span, ms',
+            buckets=TICK_BUCKETS)
+
+    def enter(self, phase: str) -> None:
+        """Open one phase section (re-entrant across phases: a nested
+        section's time is subtracted from its parent)."""
+        now = time.perf_counter()
+        if not self._stack and not self._acc:
+            self._first = now
+        self._gen += 1
+        self._stack.append([phase, now, 0.0])
+        if not self._scheduled:
+            # -1 forces the close callback to re-arm at least once:
+            # it is queued BEFORE the tick's own spill-over callbacks
+            # (cork/fan-out flushes land behind it in the same
+            # iteration), so closing on the first run would split one
+            # logical tick in two
+            self._sched_gen = -1
+            try:
+                ambient_loop().call_soon(self._tick_close)
+            except RuntimeError:
+                return          # no loop (unit test): close manually
+            self._scheduled = True
+
+    def exit(self) -> None:
+        """Close the innermost open section."""
+        now = time.perf_counter()
+        phase, t0, child = self._stack.pop()
+        dur = now - t0
+        self._acc[phase] = self._acc.get(phase, 0.0) + dur - child
+        if self._stack:
+            self._stack[-1][2] += dur
+        self._last = now
+
+    def _tick_close(self) -> None:
+        self._scheduled = False
+        self._defers += 1
+        if self._stack or (self._gen != self._sched_gen
+                           and self._defers < self.MAX_DEFERS):
+            # activity since the last look (the burst spilled into
+            # this iteration — cork/fan-out callbacks of the same
+            # logical tick): look again next iteration; close after
+            # one fully quiet pass, or at MAX_DEFERS under
+            # saturating load
+            self._sched_gen = self._gen
+            try:
+                ambient_loop().call_soon(self._tick_close)
+            except RuntimeError:
+                return
+            self._scheduled = True
+            return
+        self.close_tick()
+
+    def close_tick(self) -> None:
+        """Finalize the current tick: observe every accumulated phase
+        and the tick wall span.  Loop-driven normally; callable
+        directly where no loop runs (unit tests)."""
+        if not self._acc or self._stack:
+            return
+        self._defers = 0
+        total_ms = (self._last - self._first) * 1000.0
+        phases = {p: round(s * 1000.0, 6)
+                  for p, s in self._acc.items()}
+        self._acc = {}
+        self.ticks += 1
+        for phase, ms in phases.items():
+            self.phase_hist.observe(ms, {'phase': phase})
+        self.tick_hist.observe(total_ms)
+        self.last_tick = {'total_ms': round(total_ms, 6),
+                          'phases': phases}
+
+    def phase_p99(self, phase: str) -> float | None:
+        """p99 of one phase's per-tick duration, ms (None when the
+        phase never ran) — the mntr ``zk_tick_phase_ms_p99`` rows."""
+        labels = {'phase': phase}
+        if not self.phase_hist.count(labels):
+            return None
+        return self.phase_hist.percentile(99, labels)
+
+
+def scrape_tick_cells(collector) -> dict:
+    """Summarize the tick ledger for bench cells (bench.py write-heavy
+    and fan-out families): tick count + wall-span p50/p99, and per
+    phase the per-tick p50/p99 plus ``share`` — the fraction of
+    ledgered tick time the phase ate, the number the accept-shard and
+    io_uring roadmap items are gated on."""
+    out: dict = {}
+    try:
+        th = collector.get_collector(METRIC_TICK)
+        ph = collector.get_collector(METRIC_TICK_PHASE)
+    except ValueError:
+        return out
+    n = th.count()
+    if not n:
+        return out
+    out['ticks'] = n
+    out['tick_ms_p50'] = round(th.percentile(50), 4)
+    out['tick_ms_p99'] = round(th.percentile(99), 4)
+    total = th.sum()
+    phases: dict = {}
+    for key in ph.label_keys():
+        labels = dict(key)
+        name = labels.get('phase', '')
+        c = ph.count(labels)
+        if not c:
+            continue
+        phases[name] = {
+            'count': c,
+            'ms_p50': round(ph.percentile(50, labels), 4),
+            'ms_p99': round(ph.percentile(99, labels), 4),
+            'share': round(ph.sum(labels) / total, 3) if total else 0.0,
+        }
+    if phases:
+        out['phases'] = phases
+    return out
 
 
 def sign_test_p(wins: int, losses: int) -> float:
