@@ -7,8 +7,8 @@ PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check native bench asan chaos chaos-ensemble obs \
-    durability bench-wal bench-fanout bench-trace timeline coverage \
-    clean
+    durability election bench-wal bench-fanout bench-trace \
+    bench-election timeline coverage clean
 
 all: check test
 
@@ -43,6 +43,29 @@ chaos-ensemble:
 durability:
 	$(PYTHON) -m pytest tests/test_wal.py tests/test_chaos_ensemble.py \
 	    -q -m 'not slow'
+
+# Coordination plane (server/election.py; README "Failure
+# semantics"): the vote rule + invariant 7 units, the in-process
+# coordinator suite (heartbeat detection, quorum gate, deposed-member
+# fencing, pool re-resolution), the forced-election ensemble chaos
+# slice, and the OS-process tier — elected-leader kill loops plus
+# full-ensemble SIGKILL -> election from recovered WALs, 2
+# generations deep.  Rerun any seed with `python -m zkstream_tpu
+# chaos --tier ensemble --elections 2 --seed N` (or --tier process).
+election:
+	$(PYTHON) -m pytest tests/test_election.py -q
+	$(PYTHON) -m pytest tests/test_chaos_ensemble.py -q \
+	    -k 'election' -m 'not slow'
+	$(PYTHON) -m pytest tests/test_process_ensemble.py -q \
+	    -k 'election or member_worker'
+
+# Failover-time envelope: paired leader-kill cells at 3- vs 5-member
+# in-process ensembles — kill the leader, time detection -> elected
+# successor (zk_election_ms) and the client-observed failover (kill
+# -> first acked write through the new leader), exact sign test
+# between the sizes.  Rounds via ZKSTREAM_BENCH_ELECTION_ROUNDS.
+bench-election:
+	$(PYTHON) bench.py --election
 
 # Paired durability-cost envelope: wal-off vs sync=tick (group
 # commit) vs sync=always write-heavy cells at fleet 16/64 with

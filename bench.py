@@ -1110,6 +1110,129 @@ def bench_wal() -> None:
             }), flush=True)
 
 
+#: `bench.py --election` ensemble sizes: does failover time move with
+#: membership (more voters, same one-round tally)?
+ELECTION_SCALES = (3, 5)
+
+
+async def _election_round(members: int, heartbeat_ms: int = 40
+                          ) -> dict:
+    """One failover measurement: fresh in-process ensemble + client,
+    kill the leader, time (a) the election itself (zk_election_ms —
+    detection to promotion inside the coordinator) and (b) the
+    client-observed failover (kill to the first acked write through
+    the elected successor)."""
+    import asyncio as aio
+    import time as _t
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.protocol.errors import ZKError, ZKProtocolError
+    from zkstream_tpu.server import ZKEnsemble
+    from zkstream_tpu.server.election import METRIC_ELECTION
+    from zkstream_tpu.utils.metrics import Collector
+
+    collector = Collector()
+    ens = await ZKEnsemble(members, heartbeat_ms=heartbeat_ms,
+                           seed=members, collector=collector).start()
+    c = Client(servers=ens.addresses(), shuffle_backends=False,
+               session_timeout=8000)
+    c.start()
+    try:
+        await c.wait_connected(timeout=10)
+        await c.create('/warm', b'w')
+        elected = aio.get_running_loop().create_future()
+        ens.election.on(
+            'elected',
+            lambda m, e, d: (not elected.done()
+                             and elected.set_result(d)))
+        t0 = _t.perf_counter()
+        await ens.kill(0)
+        election_ms = await aio.wait_for(elected, 15)
+        # client-observed: first acked write through the successor
+        while True:
+            try:
+                await c.set('/warm', b'x', version=-1)
+                break
+            except (ZKError, ZKProtocolError):
+                await aio.sleep(0.01)
+        failover_ms = (_t.perf_counter() - t0) * 1000.0
+        hist = collector.get_collector(METRIC_ELECTION)
+        return {'members': members,
+                'election_ms': round(election_ms, 3),
+                'election_p50_ms': round(hist.percentile(50), 3),
+                'failover_ms': round(failover_ms, 3)}
+    finally:
+        await c.close()
+        await ens.stop()
+
+
+def bench_election() -> None:
+    """The coordination plane's failover envelope (`make
+    bench-election`): paired leader-kill cells at 3- vs 5-member
+    ensembles — per-round adjacent A/B runs, exact two-sided sign
+    test on the client-observed failover time, zk_election_ms
+    distribution per cell.  Rounds via
+    ZKSTREAM_BENCH_ELECTION_ROUNDS."""
+    import asyncio
+
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_ELECTION_ROUNDS',
+                                '10'))
+    rows: dict = {n: [] for n in ELECTION_SCALES}
+    cells: dict = {}
+    paired_rounds: list = []
+    for _rnd in range(rounds):
+        this_round: dict = {}
+        for n in ELECTION_SCALES:
+            try:
+                r = asyncio.run(_election_round(n))
+            except Exception as e:
+                print('# election cell members=%d round failed: %r'
+                      % (n, e), file=sys.stderr)
+                continue
+            rows[n].append(r['failover_ms'])
+            this_round[n] = r['failover_ms']
+            if n not in cells or r['failover_ms'] \
+                    < cells[n]['failover_ms']:
+                cells[n] = r
+        if len(this_round) == len(ELECTION_SCALES):
+            # only rounds where EVERY arm completed pair up — a
+            # failed cell must not shift later rounds against
+            # earlier ones (the adjacent-pairing contract)
+            paired_rounds.append(tuple(this_round[n]
+                                       for n in ELECTION_SCALES))
+    for n in sorted(cells):
+        print('# election_cell %s' % json.dumps(cells[n]),
+              file=sys.stderr)
+
+    for n in ELECTION_SCALES:
+        if rows[n]:
+            p50, p99 = _percentiles(rows[n])
+            print(json.dumps({
+                'metric': 'election_failover_ms',
+                'members': n,
+                'rounds': len(rows[n]),
+                'p50_ms': round(p50, 3),
+                'p99_ms': round(p99, 3),
+            }), flush=True)
+    paired = paired_rounds
+    if paired:
+        wins = sum(1 for x, y in paired if x < y)   # 3-member faster
+        losses = sum(1 for x, y in paired if x > y)
+        deltas = [(y - x) / x * 100.0 for x, y in paired if x]
+        print(json.dumps({
+            'metric': 'election_members_sign_test',
+            'pair': '%d-vs-%d-members' % ELECTION_SCALES,
+            'rounds': len(paired),
+            'wins_smaller_faster': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --traceov` fleet sizes (the acceptance envelope: the
 #: server trace plane — member span rings + tick ledger — must not be
 #: significantly slower than the untraced arm at either scale).
@@ -1500,6 +1623,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_wal()
+        return
+    if '--election' in sys.argv:
+        # `make bench-election`: the coordination-plane failover
+        # family (leader kill -> elected successor, 3 vs 5 members).
+        # Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_election()
         return
     if '--traceov' in sys.argv:
         # `make bench-trace`: the paired trace-plane overhead family

@@ -24,7 +24,17 @@ Roles:
                            base (tail-only resync) instead of an
                            empty-tree snapshot fetch.
 
-Both run until killed — being SIGKILLed mid-service is the point of
+  member <id> <wal_dir> <client_port> <election_port> [id:host:port..]
+                         — a SYMMETRIC peer with no pre-assigned role
+                           (server/election.py): recovers its WAL,
+                           votes with the recovered (epoch, zxid),
+                           and leads or follows — re-electing on
+                           every leader loss.  Delegates to the
+                           package worker
+                           (zkstream_tpu/server/member_worker.py),
+                           which the election harness spawns directly.
+
+All run until killed — being SIGKILLed mid-service is the point of
 the tier (reference: test/multi-node.test.js:309-338 kills real server
 processes; test/zkserver.js:236-264 hunts child PIDs)."""
 
@@ -119,6 +129,10 @@ def main() -> int:
     role = sys.argv[1]
     if role == 'leader':
         asyncio.run(run_leader(*sys.argv[2:4]))
+    elif role == 'member':
+        from zkstream_tpu.server import member_worker
+        sys.argv = sys.argv[1:]       # member_worker parses from [1]
+        return member_worker.main()
     else:
         assert role == 'follower', role
         asyncio.run(run_follower(sys.argv[2], int(sys.argv[3]),

@@ -371,6 +371,38 @@ async def test_ensemble_campaign_tier1_slice():
     assert not bad, _campaign_failure_report(bad)
 
 
+@pytest.mark.timeout(180)
+async def test_forced_election_schedules_pass_invariants():
+    """The election plane's ensemble-tier acceptance: seeded
+    schedules with >= 2 FORCED elections (the current leader is
+    killed at evenly spaced plan steps; the heartbeat monitor must
+    elect a successor each time) pass every invariant — the new
+    at-most-one-leader-per-epoch / epoch-monotonicity check included
+    — and remain rerunnable via `chaos --tier ensemble --seed N
+    --elections 2`."""
+    bad = []
+    for seed in (BASE_SEED, BASE_SEED + 3):
+        r = await run_ensemble_schedule(seed, elections=2)
+        assert r.elections >= 2, (seed, r.elections, r.violations)
+        epochs = [rec['epoch'] for rec in r.history
+                  if rec['kind'] == 'election']
+        assert epochs == sorted(epochs), epochs
+        if not r.ok:
+            bad.append(r)
+    assert not bad, _campaign_failure_report(bad)
+
+
+async def test_schedule_runs_on_static_leader_fallback(monkeypatch):
+    """ZKSTREAM_NO_ELECTION=1 keeps the static member-0 leader as the
+    env-gated validator path: the same seeded schedule runs with no
+    coordinator and no election records."""
+    monkeypatch.setenv('ZKSTREAM_NO_ELECTION', '1')
+    r = await run_ensemble_schedule(BASE_SEED)
+    assert r.elections == 0
+    assert not any(rec['kind'] == 'election' for rec in r.history)
+    assert r.ok, r.violations
+
+
 @pytest.mark.slow
 @pytest.mark.timeout(900)
 async def test_ensemble_campaign_full():

@@ -455,3 +455,55 @@ async def test_trce_scrape_merges_cross_process_timeline(
         assert format_timeline(merged)
     finally:
         await c.close()
+
+
+@pytest.mark.timeout(240)
+async def test_election_kill_loop_and_full_sigkill_generations():
+    """The election plane's OS-process acceptance, via the exact
+    seeded driver `zkstream_tpu chaos --tier process --seed N` runs
+    (server/election.py run_process_schedule): three symmetric peer
+    members; the elected leader is SIGKILLed twice and each survivor
+    set elects a successor at a strictly higher epoch with no
+    operator; then the WHOLE ensemble is SIGKILLed twice and each
+    generation elects from recovered WALs alone — every acked write
+    intact, invariant 7 (one leader per epoch, epochs monotone)
+    checked over the recorded history."""
+    from zkstream_tpu.server.election import run_process_schedule
+
+    r = await run_process_schedule(seed=5, ops=3, elections=2,
+                                   generations=2)
+    assert r.ok, r.violations
+    # initial + 2 forced + 2 full-ensemble generations
+    assert r.elections >= 5, r.history
+    epochs = [rec['epoch'] for rec in r.history
+              if rec['kind'] == 'election']
+    assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs)
+    assert epochs[-1] >= 5
+    assert r.acked > 0
+
+
+@pytest.mark.timeout(120)
+async def test_member_worker_role_via_test_worker():
+    """The tests/ worker's `member` role delegates to the package
+    worker: one single-member 'ensemble' elects itself leader from an
+    empty WAL and serves clients."""
+    import tempfile
+
+    from zkstream_tpu.server.election import allocate_ports
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        cport, eport = allocate_ports(2)
+        m = _spawn('member', '0', wal_dir, str(cport), str(eport))
+        try:
+            c = _client([('127.0.0.1', m.ports[0])])
+            try:
+                await c.wait_connected(timeout=15)
+                await c.create('/solo', b'x')
+                data, _ = await c.get('/solo')
+                assert data == b'x'
+            finally:
+                await c.close()
+        finally:
+            m.proc.kill()
+            m.proc.wait()
+            m.proc.stdout.close()

@@ -409,6 +409,9 @@ ERROR_REPLY_FIXTURES = [
     # (opcode, error-code wire bytes, expected error name)
     ('CREATE', b'\xff\xff\xff\x92', 'NODE_EXISTS'),            # -110
     ('CREATE', b'\xff\xff\xff\x8e', 'INVALID_ACL'),            # -114
+    # this stack's own code (server/election.py): a deposed member's
+    # write, definitively rejected at a stale leadership epoch
+    ('CREATE', b'\xff\xff\xff\x7e', 'EPOCH_FENCED'),           # -130
     ('CREATE', b'\xff\xff\xff\x94',
      'NO_CHILDREN_FOR_EPHEMERALS'),                            # -108
     ('DELETE', b'\xff\xff\xff\x91', 'NOT_EMPTY'),              # -111

@@ -336,13 +336,18 @@ def build_parser() -> argparse.ArgumentParser:
         'chaos',
         help='run seeded fault-injection schedules against an '
              'in-process server and verify the resilience invariants')
-    ch.add_argument('--tier', choices=('transport', 'ensemble'),
+    ch.add_argument('--tier',
+                    choices=('transport', 'ensemble', 'process'),
                     default='transport',
                     help='transport: byte/socket faults against one '
                          'server; ensemble: member kills/restarts, '
                          'replication partitions and session '
                          'migration with the history-checked '
-                         'invariant engine (io/invariants.py)')
+                         'invariant engine (io/invariants.py); '
+                         'process: OS-process peer members — seeded '
+                         'elected-leader kill loops plus full-'
+                         'ensemble SIGKILL -> election from '
+                         'recovered WALs (server/election.py)')
     ch.add_argument('--seed', type=int, default=0,
                     help='base seed; schedule i uses seed+i (default 0)')
     ch.add_argument('--schedules', type=int, default=20,
@@ -358,6 +363,18 @@ def build_parser() -> argparse.ArgumentParser:
                          'fan-out table (server/watchtable.py) — '
                          'bisects whether a failing seed implicates '
                          'the table')
+    ch.add_argument('--elections', type=int, default=None,
+                    help='ensemble tier: force N leader elections '
+                         'per schedule (kill the current leader at '
+                         'evenly spaced steps; each must elect a '
+                         'successor).  Part of the rerun key: seed + '
+                         'this flag reproduce the schedule exactly. '
+                         'Default: drawn per seed')
+    ch.add_argument('--no-election', action='store_true',
+                    help='rerun with the static member-0 leader '
+                         '(ZKSTREAM_NO_ELECTION=1) — bisects whether '
+                         'a failing seed implicates the election '
+                         'plane (server/election.py)')
     ch.add_argument('--trace-out', metavar='PATH', default=None,
                     help='write every schedule\'s xid-correlated span '
                          'dump — member kill/restart events included '
@@ -426,17 +443,21 @@ async def _chaos(args) -> int:
         # the schedule servers resolve their dispatch path from the
         # env at construction, exactly like the cork/codec tiers
         os.environ['ZKSTREAM_NO_WATCHTABLE'] = '1'
+    if getattr(args, 'no_election', False):
+        os.environ['ZKSTREAM_NO_ELECTION'] = '1'
 
     def progress(r):
         if args.quiet and r.ok:
             return
         status = 'ok ' if r.ok else 'FAIL'
         print('seed %6d  %s  ops=%d acked=%d typed_errs=%d '
-              'deadline=%d faults=%d watch_fires=%d%s'
+              'deadline=%d faults=%d watch_fires=%d%s%s'
               % (r.seed, status, r.ops, r.acked, r.typed_errors,
                  r.deadline_errors, r.faults, r.watch_fires,
                  '' if r.tier == 'transport'
-                 else ' member_events=%d' % (len(r.member_events),)))
+                 else ' member_events=%d' % (len(r.member_events),),
+                 '' if not r.elections
+                 else ' elections=%d' % (r.elections,)))
         for v in r.violations:
             print('    violation: %s' % (v,))
         if not r.ok and r.history:
@@ -462,7 +483,22 @@ async def _chaos(args) -> int:
         results = await run_ensemble_campaign(
             args.seed, args.schedules,
             ops=args.ops if args.ops is not None else 12,
-            progress=progress)
+            progress=progress,
+            elections=getattr(args, 'elections', None))
+    elif args.tier == 'process':
+        if getattr(args, 'no_election', False):
+            # the process tier IS the election plane: there is no
+            # static-leader variant of symmetric peers to bisect to
+            print('error: --no-election has no meaning on the '
+                  'process tier (symmetric peers have no static '
+                  'leader); use --tier ensemble', file=sys.stderr)
+            return 2
+        from .server.election import run_process_campaign
+        results = await run_process_campaign(
+            args.seed, args.schedules,
+            ops=args.ops if args.ops is not None else 6,
+            progress=progress,
+            elections=getattr(args, 'elections', None))
     else:
         results = await run_campaign(
             args.seed, args.schedules,
@@ -638,8 +674,12 @@ def _wal(args) -> int:
             for idx, entry in seg.records:
                 extra = ('' if entry[0] != 'create'
                          else ' data=%dB' % (len(entry[2]),))
+                # epoch control records carry the new epoch, not a
+                # path (server/election.py's fencing token)
+                what = ('epoch=%d' % (entry[1],)
+                        if entry[0] == 'epoch' else entry[1])
                 print('    #%-6d zxid=%-6d %-8s %s%s'
-                      % (idx, entry_zxid(entry), entry[0], entry[1],
+                      % (idx, entry_zxid(entry), entry[0], what,
                          extra))
     print('snapshots:')
     if not scan.snapshots:

@@ -579,6 +579,10 @@ class ScheduleResult:
     #: invariant engine (io/invariants.py) checked, as JSON-ready
     #: dicts.
     history: list = dataclasses.field(default_factory=list)
+    #: Ensemble/process tiers: completed leader elections observed
+    #: during the schedule (server/election.py; invariant 7 replays
+    #: the election records carried in ``history``).
+    elections: int = 0
 
     @property
     def ok(self) -> bool:
@@ -874,6 +878,13 @@ class FaultPlan:
     durability: str = 'tick'
     #: small segments force rotation + fuzzy snapshots mid-schedule
     wal_segment_bytes: int = 1 << 16
+    #: forced leader elections (server/election.py): the schedule
+    #: kills the CURRENT leader at evenly spaced plan steps —
+    #: restarting members first when the survivors would fall under a
+    #: quorum — and each kill must produce an elected successor at a
+    #: strictly higher epoch within the bounded wait, with invariant
+    #: 7 replaying the election records afterwards
+    elections: int = 0
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -891,7 +902,19 @@ class FaultPlan:
         plan.durability = rng.choice(['tick', 'tick', 'always'])
         plan.wal_segment_bytes = rng.choice([1 << 12, 1 << 14,
                                              1 << 20])
+        # its own stream, same rule: adding the election plane must
+        # not perturb the transport/plan draws existing seeds pin
+        erng = random.Random('plan-elect/%d' % (seed,))
+        plan.elections = erng.choice([0, 0, 0, 1, 2])
         return plan
+
+    def forced_election_steps(self) -> set[int]:
+        """The plan steps that force an election (evenly spaced
+        through the schedule, before the drawn action of that step)."""
+        if self.elections <= 0:
+            return set()
+        return {((k + 1) * self.ops) // (self.elections + 1)
+                for k in range(self.elections)}
 
 
 class EnsembleUnderTest:
@@ -916,19 +939,28 @@ class EnsembleUnderTest:
 
     def __init__(self, members: int = 3, wal_dir: str | None = None,
                  durability: str | None = None,
-                 wal_segment_bytes: int | None = None):
+                 wal_segment_bytes: int | None = None,
+                 seed: int | None = None):
         from ..server.replication import ReplicationService
         from ..server.server import ZKEnsemble
 
+        #: heartbeat shrunk for campaign pace: leader-loss detection
+        #: inside a few plan steps instead of half a second
         self._ens = ZKEnsemble(members, lag=0.0, wal_dir=wal_dir,
                                durability=durability,
-                               wal_segment_bytes=wal_segment_bytes)
+                               wal_segment_bytes=wal_segment_bytes,
+                               heartbeat_ms=40, seed=seed)
         self.db = self._ens.db
         self.servers = self._ens.servers
+        self.coordinator = self._ens.election
         self.svc = ReplicationService(self.db)
         self.dead: set[int] = set()
         self.remote = None           # RemoteLeader (events/control)
         self.replica = None          # RemoteReplicaStore over it
+
+    @property
+    def leader_idx(self) -> int:
+        return self._ens.leader_idx
 
     async def start(self) -> 'EnsembleUnderTest':
         from ..server.replication import (
@@ -994,7 +1026,8 @@ class EnsembleUnderTest:
 
 async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 collector=None,
-                                plan: FaultPlan | None = None
+                                plan: FaultPlan | None = None,
+                                elections: int | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
     concurrent client workload, every op recorded into an append-only
@@ -1013,6 +1046,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
 
     if plan is None:
         plan = FaultPlan.randomized(seed, ops=ops)
+    if elections is not None:
+        # explicit override (chaos --elections N): part of the rerun
+        # key — seed + flags reproduce the schedule exactly
+        plan.elections = elections
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble')
     h = History()
@@ -1021,7 +1058,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     crash_dir = tempfile.mkdtemp(prefix='zkchaos-ens-crash-')
     ens = await EnsembleUnderTest(
         plan.members, wal_dir=wal_dir, durability=plan.durability,
-        wal_segment_bytes=plan.wal_segment_bytes).start()
+        wal_segment_bytes=plan.wal_segment_bytes, seed=seed).start()
     ens.install_faults(inj)
 
     ingest = None
@@ -1060,6 +1097,54 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         h.member_event(event, member)
         client.trace.note('MEMBER_' + event.upper(),
                           path='member:%s' % (member,), kind='member')
+
+    if ens.coordinator is None:
+        # static-leader validator path (ZKSTREAM_NO_ELECTION=1 /
+        # election=False): a drawn election count is meaningless here
+        # and must not read as a missed-election violation
+        plan.elections = 0
+    else:
+        # every completed election lands in the history (invariant 7
+        # replays these) AND the client span ring, so a failing seed's
+        # timeline shows the failover causally
+        def on_elected(member, epoch, dur_ms):
+            h.election(member, epoch)
+            client.trace.note('ELECTED',
+                              path='member:%s' % (member,),
+                              kind='member',
+                              detail='epoch=%d' % (epoch,),
+                              duration_ms=round(dur_ms, 3))
+        ens.coordinator.on('elected', on_elected)
+
+    def elections_seen() -> int:
+        return sum(1 for r in h.records if r['kind'] == 'election')
+
+    async def force_election() -> None:
+        """Kill the CURRENT leader and wait for the coordinator to
+        elect a successor — restarting dead members first when the
+        survivors would fall under a quorum.  The detection path is
+        the real one (heartbeat monitor), not a direct call."""
+        if ens.coordinator is None:
+            return
+        need = len(ens.servers) // 2 + 1
+        while ens.dead and len(ens.live()) - 1 < need:
+            back = sorted(ens.dead)[0]
+            note_member('restart', back)
+            await ens.restart(back)
+        lead = ens.leader_idx
+        before = elections_seen()
+        if lead not in ens.dead:
+            note_member('kill-leader', lead)
+            await ens.kill(lead)
+        deadline = 8.0
+        step = 0.02
+        while elections_seen() <= before and deadline > 0:
+            await asyncio.sleep(step)
+            deadline -= step
+        if elections_seen() <= before:
+            res.violations.append(
+                'forced election: no successor elected within 8s of '
+                'killing leader %d' % (lead,))
 
     def sid() -> int:
         for r in reversed(h.records):
@@ -1138,9 +1223,12 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             h.acked_set('/w', 0, sid(), zxid=last_zxid())
         await do_create('/seq', b'')
 
+        forced_steps = plan.forced_election_steps()
         for i in range(plan.ops):
             await wait_usable(1.5)
             res.ops += 1
+            if i in forced_steps:
+                await force_election()
             act = inj.choice('plan', PLAN_ACTIONS)
             if act == 'set':
                 set_idx += 1
@@ -1216,10 +1304,13 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 note_member('kill', victim)
                 await ens.kill(victim)
             elif act == 'kill_leader':
-                if 0 in ens.dead or len(ens.live()) <= 1:
+                # the CURRENT leader: with election on it may be any
+                # member (a previous kill already moved leadership)
+                lead = ens.leader_idx
+                if lead in ens.dead or len(ens.live()) <= 1:
                     continue
-                note_member('kill', 0)
-                await ens.kill(0)
+                note_member('kill', lead)
+                await ens.kill(lead)
             elif act == 'restart':
                 if not ens.dead:
                     continue
@@ -1288,6 +1379,15 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                            sorted(extra)[:8]))
 
         res.watch_fires = len(fires)
+        # compare against the steps actually SCHEDULED: with ops <
+        # elections+1 the evenly-spaced steps collide and fewer
+        # elections are forced — that is a plan-shape fact, not a
+        # missed election
+        forced_n = len(plan.forced_election_steps())
+        if forced_n and elections_seen() < forced_n:
+            res.violations.append(
+                'plan forced %d election(s) but only %d completed'
+                % (forced_n, elections_seen()))
         res.violations.extend(check_history(h, ens.db))
 
         # -- durability: full-ensemble SIGKILL + restart-from-disk --
@@ -1362,16 +1462,20 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         # derived, never dual-appended: the history's member records
         # ARE the timeline
         res.member_events = h.member_timeline()
+        res.elections = sum(1 for r in h.records
+                            if r['kind'] == 'election')
 
 
 async def run_ensemble_campaign(base_seed: int, schedules: int,
-                                ops: int = 12,
-                                progress=None) -> list[ScheduleResult]:
+                                ops: int = 12, progress=None,
+                                elections: int | None = None
+                                ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
     starting at ``base_seed``."""
     out = []
     for i in range(schedules):
-        r = await run_ensemble_schedule(base_seed + i, ops=ops)
+        r = await run_ensemble_schedule(base_seed + i, ops=ops,
+                                        elections=elections)
         out.append(r)
         if progress is not None:
             progress(r)

@@ -48,6 +48,14 @@ failing seed's report reads without the source):
    tolerated) holds every unambiguously-acked write, with the same
    ambiguity rules as invariant 1.  Both chaos tiers run it against a
    crash image cut at an injector-chosen fsync window.
+7. **Election safety** (:func:`check_election`, the coordination
+   plane — server/election.py) — over the recorded election history:
+   at most ONE leader is ever elected per epoch, and elected epochs
+   strictly increase in history order.  A second winner at an epoch
+   means the fencing token was forged or reused; a non-increasing
+   epoch means a deposed leader's era could be mistaken for current.
+   Invariants 1 and 6 run unchanged across elections — failover must
+   not lose an acked write.
 
 The history is plain data (a list of dicts) so it can ride a JSON
 trace dump next to the span ring; :func:`format_history` renders the
@@ -123,6 +131,11 @@ class History:
         """Ensemble-tier event: kill / restart / partition / heal /
         lag / migrate."""
         return self._add('member', event=event, member=member)
+
+    def election(self, member: int | str, epoch: int) -> dict:
+        """A completed leader election (server/election.py): ``member``
+        won ``epoch``.  Invariant 7 replays these."""
+        return self._add('election', member=member, epoch=epoch)
 
     def session_event(self, event: str, session_id: int) -> dict:
         return self._add('session', event=event,
@@ -395,6 +408,33 @@ def check_watch_once(history: History) -> list[str]:
     return out
 
 
+def check_election(history: History) -> list[str]:
+    """Invariant 7: at most one elected leader per epoch, and elected
+    epochs strictly increase in history order."""
+    out: list[str] = []
+    winners: dict[int, object] = {}
+    prev: int | None = None
+    for r in history.of_kind('election'):
+        epoch, member = r['epoch'], r['member']
+        if epoch in winners:
+            # re-observing a standing leader (a scrape after a
+            # restart) is fine; a DIFFERENT winner at the same epoch
+            # means the fencing token was reused
+            if winners[epoch] != member:
+                out.append(
+                    'two leaders elected at epoch %d: member %s and '
+                    'member %s' % (epoch, winners[epoch], member))
+        else:
+            winners[epoch] = member
+            if prev is not None and epoch <= prev:
+                out.append(
+                    'elected epoch not increasing: %d won after %d '
+                    '(a deposed era could be mistaken for current)'
+                    % (epoch, prev))
+        prev = epoch if prev is None else max(prev, epoch)
+    return out
+
+
 def check_history(history: History, db) -> list[str]:
     """Run every invariant against the history and the leader's
     final database; returns the combined violation list."""
@@ -404,11 +444,12 @@ def check_history(history: History, db) -> list[str]:
     out.extend(check_ephemerals(history, db))
     out.extend(check_sequential(history))
     out.extend(check_watch_once(history))
+    out.extend(check_election(history))
     return out
 
 
 def format_history(history: 'History | list[dict]',
-                   kinds=('member', 'session'),
+                   kinds=('member', 'session', 'election'),
                    limit: int | None = None) -> str:
     """Render the member-event (and session-edge) timeline for a
     failure report, oldest first.  Accepts a :class:`History` or a
@@ -423,6 +464,10 @@ def format_history(history: 'History | list[dict]',
         if r['kind'] == 'member':
             lines.append('  t=%-4d member %-8s %s'
                          % (r['t'], r['member'], r['event']))
+        elif r['kind'] == 'election':
+            lines.append('  t=%-4d member %-8s ELECTED leader '
+                         '(epoch %d)'
+                         % (r['t'], r['member'], r['epoch']))
         else:
             lines.append('  t=%-4d session %016x %s'
                          % (r['t'], r['session_id'], r['event']))

@@ -54,6 +54,12 @@ class ErrCode(enum.IntEnum):
     INVALID_CALLBACK = -113
     INVALID_ACL = -114
     AUTH_FAILED = -115
+    #: This stack's own (no reference analogue): a write reached a
+    #: member whose leadership epoch is stale — a deposed leader, or a
+    #: follower forwarding under an epoch the quorum has moved past
+    #: (server/election.py).  Typed, definite failure: the write was
+    #: NOT applied; retry after the member rejoins the current epoch.
+    EPOCH_FENCED = -130
 
 
 #: Human-readable explanations for ErrCode values
@@ -87,6 +93,9 @@ ERR_TEXT: dict[str, str] = {
     'INVALID_ACL': 'The given ZooKeeper ACL was found to be invalid on '
         'the server side',
     'AUTH_FAILED': 'ZooKeeper authentication failed',
+    'EPOCH_FENCED': 'The serving member\'s leadership epoch is stale '
+        '(a newer leader has been elected); the write was rejected, '
+        'not applied',
 }
 
 

@@ -1,0 +1,52 @@
+"""One symmetric ensemble-member OS process (server/election.py).
+
+Unlike tests/process_member_worker.py's fixed ``leader``/``follower``
+roles, a *member* has no pre-assigned role: it recovers whatever its
+WAL directory holds, votes with the recovered (epoch, zxid) pair, and
+ends up leading or following — re-electing on every leader loss —
+until killed.  Spawned by the process-tier election harness
+(``run_process_schedule``) and tests/test_process_ensemble.py.
+
+Usage::
+
+    python member_worker.py ID WAL_DIR CLIENT_PORT ELECTION_PORT \
+        [PEER_ID:HOST:PORT ...]
+
+Prints ``READY <client_port> <election_port>`` once the member serves
+clients under its first resolved role.  ``ZKSTREAM_MEMBER_SYNC``
+picks the WAL fsync policy (default ``tick``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+
+def main() -> int:
+    # keep jax fully out of the picture, same as the test workers:
+    # the server stack is pure asyncio and must not touch a possibly
+    # wedged accelerator plugin via the image's site hook
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from zkstream_tpu.server.election import run_member
+
+    member_id = int(sys.argv[1])
+    wal_dir = sys.argv[2]
+    client_port = int(sys.argv[3])
+    election_port = int(sys.argv[4])
+    peers = []
+    for spec in sys.argv[5:]:
+        pid, host, port = spec.split(':')
+        peers.append((int(pid), host, int(port)))
+    sync = os.environ.get('ZKSTREAM_MEMBER_SYNC', 'tick')
+    asyncio.run(run_member(member_id, wal_dir, client_port,
+                           election_port, peers, sync=sync))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -129,7 +129,10 @@ def crc32c(data: bytes, crc: int = 0) -> int:
 # Txn record body codec: fast single-pass tier + jute spec tier.
 # ---------------------------------------------------------------------
 
-_TAGS = {'create': 1, 'delete': 2, 'set_data': 3}
+#: Tag 4 ('epoch') is a *control* record — a leadership-epoch bump
+#: (server/election.py), logged for recovery but never applied to the
+#: tree and never entered into the replication log.
+_TAGS = {'create': 1, 'delete': 2, 'set_data': 3, 'epoch': 4}
 _OPS = {v: k for k, v in _TAGS.items()}
 
 _REC_HDR = struct.Struct('>II')       # length, crc32c(body)
@@ -142,13 +145,21 @@ _Q2 = struct.Struct('>qq')
 MAX_RECORD = 64 * 1024 * 1024
 
 MAGIC_SEGMENT = b'ZKSWAL1\n'
-MAGIC_SNAPSHOT = b'ZKSSNP1\n'
-_SNAP_HDR = struct.Struct('>QQI')     # index, zxid, crc32(payload)
+#: Snapshot format 2 adds the leadership epoch to the stamp (a
+#: snapshot that anchors truncation may be the only survivor of the
+#: epoch record it covers).  Format-1 images stay READABLE (epoch 0):
+#: truncation may already have deleted the segments under an existing
+#: snapshot, so rejecting it would orphan the acked writes it covers.
+MAGIC_SNAPSHOT = b'ZKSSNP2\n'
+MAGIC_SNAPSHOT_V1 = b'ZKSSNP1\n'
+_SNAP_HDR = struct.Struct('>QQQI')    # index, zxid, epoch, crc32(payload)
+_SNAP_HDR_V1 = struct.Struct('>QQI')  # index, zxid, crc32(payload)
 
 
 def entry_zxid(entry: tuple) -> int:
     """The zxid a commit-log entry was sequenced at (store.py shapes:
-    create[5], delete[2], set_data[3])."""
+    create[5], delete[2], set_data[3]; epoch control records carry the
+    zxid current at the bump — they consume no zxid themselves)."""
     op = entry[0]
     if op == 'create':
         return entry[5]
@@ -156,6 +167,8 @@ def entry_zxid(entry: tuple) -> int:
         return entry[2]
     if op == 'set_data':
         return entry[3]
+    if op == 'epoch':
+        return entry[2]
     raise ValueError('unknown log entry %r' % (op,))
 
 
@@ -165,6 +178,11 @@ def _spec_encode_entry(entry: tuple) -> bytes:
     w = JuteWriter()
     op = entry[0]
     w.write_byte(_TAGS[op])
+    if op == 'epoch':
+        _, epoch, zxid = entry
+        w.write_long(epoch)
+        w.write_long(zxid)
+        return w.to_bytes()
     if op == 'create':
         _, path, data, acl, eph_owner, zxid, now = entry
         w.write_ustring(path)
@@ -208,6 +226,8 @@ def encode_entry(entry: tuple) -> bytes:
         p = path.encode('utf-8')
         return b''.join((b'\x03', _I.pack(len(p)), p, _buf(data),
                          _Q2.pack(zxid, now)))
+    if op == 'epoch':
+        return b'\x04' + _Q2.pack(entry[1], entry[2])
     if op == 'create':
         _, path, data, acl, eph_owner, zxid, now = entry
         p = path.encode('utf-8')
@@ -256,6 +276,8 @@ def decode_entry(body: bytes) -> tuple:
         return ('create', path, data, acl, eph_owner, zxid, now)
     if op == 'delete':
         return ('delete', r.read_ustring(), r.read_long())
+    if op == 'epoch':
+        return ('epoch', r.read_long(), r.read_long())
     return ('set_data', r.read_ustring(), bytes(r.read_buffer()),
             r.read_long(), r.read_long())
 
@@ -300,6 +322,8 @@ class SnapshotInfo:
     valid: bool
     nodes: dict | None = None
     error: str | None = None
+    #: leadership epoch at capture (format 2 stamp)
+    epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -360,17 +384,26 @@ def _read_snapshot(path: str, load_nodes: bool = True) -> SnapshotInfo:
     try:
         with open(path, 'rb') as f:
             buf = f.read()
-        if not buf.startswith(MAGIC_SNAPSHOT):
+        if buf.startswith(MAGIC_SNAPSHOT):
+            index, zxid, epoch, crc = _SNAP_HDR.unpack_from(
+                buf, len(MAGIC_SNAPSHOT))
+            body_off = len(MAGIC_SNAPSHOT) + _SNAP_HDR.size
+        elif buf.startswith(MAGIC_SNAPSHOT_V1):
+            # pre-election format: no epoch in the stamp
+            index, zxid, crc = _SNAP_HDR_V1.unpack_from(
+                buf, len(MAGIC_SNAPSHOT_V1))
+            epoch = 0
+            body_off = len(MAGIC_SNAPSHOT_V1) + _SNAP_HDR_V1.size
+        else:
             raise ValueError('bad snapshot magic')
-        index, zxid, crc = _SNAP_HDR.unpack_from(buf,
-                                                 len(MAGIC_SNAPSHOT))
-        payload = buf[len(MAGIC_SNAPSHOT) + _SNAP_HDR.size:]
+        payload = buf[body_off:]
         if zlib.crc32(payload) & 0xFFFFFFFF != crc:
             raise ValueError('snapshot payload fails CRC')
         nodes = pickle.loads(payload) if load_nodes else None
         if load_nodes and '/' not in nodes:
             raise ValueError('snapshot image has no root')
-        return SnapshotInfo(path, index, zxid, True, nodes)
+        return SnapshotInfo(path, index, zxid, True, nodes,
+                            epoch=epoch)
     except Exception as e:
         # parse the stamp out of the filename so the CLI can still
         # list the corrupt file next to its intended position
@@ -421,6 +454,10 @@ class Recovery:
     replayed: int           # log entries applied on top of the image
     torn: bool              # a torn/invalid tail was tolerated
     detail: str = ''
+    #: newest leadership epoch on disk (snapshot stamp or epoch
+    #: control records, whichever is higher) — what a recovered
+    #: member votes with (server/election.py)
+    epoch: int = 0
 
 
 def recover_state(path: str, trace=None) -> Recovery:
@@ -440,6 +477,7 @@ def recover_state(path: str, trace=None) -> Recovery:
         tree.install({'zxid': snap.zxid, 'nodes': snap.nodes})
     base_zxid = tree.zxid
     base_index = snap.index if snap is not None else 0
+    epoch = snap.epoch if snap is not None else 0
     replayed = 0
     torn = False
     last_index = base_index
@@ -457,6 +495,13 @@ def recover_state(path: str, trace=None) -> Recovery:
             last_index = max(last_index, nxt)
             continue
         for idx, entry in seg.records:
+            if entry[0] == 'epoch':
+                # control record: adopt the epoch (zxid filter does
+                # not apply — a bump consumes no zxid), never applied
+                # to the tree
+                epoch = max(epoch, entry[1])
+                last_index = max(last_index, idx + 1)
+                continue
             if entry_zxid(entry) <= base_zxid:
                 last_index = max(last_index, idx + 1)
                 continue               # covered by the image
@@ -479,7 +524,8 @@ def recover_state(path: str, trace=None) -> Recovery:
                    last_index=last_index,
                    snapshot_index=snap.index if snap else -1,
                    snapshot_zxid=snap.zxid if snap else 0,
-                   replayed=replayed, torn=torn, detail=detail)
+                   replayed=replayed, torn=torn, detail=detail,
+                   epoch=epoch)
     if trace is not None:
         trace.note('WAL_RECOVER', path=path, zxid=rec.zxid,
                    kind='recovery',
@@ -488,22 +534,26 @@ def recover_state(path: str, trace=None) -> Recovery:
     return rec
 
 
-def _restore_seq(tree, entry) -> None:
-    """Leader-side sequential counters are resolved *before* a create
-    is logged, so replay must re-derive them: a recovered leader whose
-    parent.seq lagged would hand out an already-used number.  The
-    10-digit suffix heuristic can only over-advance the counter (a
-    user node that merely looks sequential skips numbers — harmless);
-    it can never reuse one."""
-    if entry[0] != 'create':
-        return
-    path = entry[1]
-    name = path.rsplit('/', 1)[1]
+def _advance_seq(tree, path: str) -> None:
+    """Advance the parent's sequential counter past ``path``'s
+    10-digit suffix (when it has one).  The ONE copy of the
+    heuristic — replay recovery and leader promotion both use it; it
+    can only over-advance a counter (a user node that merely looks
+    sequential skips numbers — harmless), never reuse one."""
+    name = path.rsplit('/', 1)[-1]
     if len(name) > 10 and name[-10:].isdigit():
         from .store import parent_path
         parent = tree.nodes.get(parent_path(path))
         if parent is not None:
             parent.seq = max(parent.seq, int(name[-10:]) + 1)
+
+
+def _restore_seq(tree, entry) -> None:
+    """Leader-side sequential counters are resolved *before* a create
+    is logged, so replay must re-derive them: a recovered leader whose
+    parent.seq lagged would hand out an already-used number."""
+    if entry[0] == 'create':
+        _advance_seq(tree, entry[1])
 
 
 # ---------------------------------------------------------------------
@@ -1031,12 +1081,13 @@ class WriteAheadLog:
         if tree is None:
             return False
         index, zxid = self.next_index, tree.zxid
+        epoch = getattr(tree, 'epoch', 0)
         payload = pickle.dumps(tree.nodes,
                                protocol=pickle.HIGHEST_PROTOCOL)
         final = os.path.join(self.dir, 'snap.%016d' % (index,))
         tmp = final + '.tmp'
         blob = (MAGIC_SNAPSHOT
-                + _SNAP_HDR.pack(index, zxid,
+                + _SNAP_HDR.pack(index, zxid, epoch,
                                  zlib.crc32(payload) & 0xFFFFFFFF)
                 + payload)
 
@@ -1212,6 +1263,15 @@ def attach_wal(db, wal: WriteAheadLog) -> None:
     wal.bind(db)
 
 
+def restore_sequential_counters(tree) -> None:
+    """Re-derive every parent's sequential counter from the node names
+    it holds — what a follower promoted to leader (server/election.py)
+    must do before allocating sequential names: its replica tree never
+    consulted ``seq``, so the counters are all zero."""
+    for path in list(tree.nodes):
+        _advance_seq(tree, path)
+
+
 def reap_orphan_ephemerals(db) -> int:
     """Delete recovered ephemerals whose owning session did not
     survive (a full-ensemble crash kills every session; real ZK
@@ -1243,6 +1303,7 @@ def open_wal_database(path: str, *, sync: str = 'tick',
     db = ZKDatabase()
     db.nodes = rec.nodes
     db.zxid = rec.zxid
+    db.epoch = rec.epoch
     db.log_start_zxid = rec.zxid
     wal = WriteAheadLog(path, sync=sync, segment_bytes=segment_bytes,
                         segment_age_s=segment_age_s,

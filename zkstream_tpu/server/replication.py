@@ -34,9 +34,13 @@ must never listen on a non-loopback interface.
 Follower restart is supported the way real ZK does it: a follower
 joining after history began (or rejoining after a SIGKILL) is
 bootstrapped from a leader snapshot — the tree image plus its log
-position — and replays only the tail from there.  The one deliberate
-limitation: the leader process is the quorum — killing it kills the
-ensemble (no election).
+position — and replays only the tail from there.  Killing the leader
+no longer kills the quorum: followers detect the push-channel EOF and
+elect a replacement over their recovered (epoch, zxid) pairs
+(server/election.py); every push and forwarded-write ack here is
+stamped with the leadership epoch, stale-epoch pushes are rejected by
+the mirror, and a deposed leader's forwarded writes bounce with a
+typed EPOCH_FENCED error instead of being silently applied.
 """
 
 from __future__ import annotations
@@ -56,6 +60,28 @@ from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
 log = logging.getLogger('zkstream_tpu.server.replication')
 
 _LEN = struct.Struct('>I')
+
+
+class ZKLeaderLostError(ZKOpError):
+    """The leader process died (or the control channel was severed)
+    mid-RPC: the forwarded write's outcome is unknown.  Typed as
+    ``CONNECTION_LOSS`` — the outcome-unknown code the client-side
+    ambiguity accounting (io/invariants.py AMBIGUOUS_CODES) already
+    classifies — so a follower's request handler converts it into an
+    honest wire error instead of tearing the client connection down
+    with a raw ``ConnectionError``."""
+
+    def __init__(self, detail: str = ''):
+        super().__init__('CONNECTION_LOSS')
+        self.detail = detail
+
+
+class ZKEpochFencedError(ZKOpError):
+    """A write carried (or arrived at) a stale leadership epoch
+    (server/election.py): definitively rejected, never applied."""
+
+    def __init__(self):
+        super().__init__('EPOCH_FENCED')
 
 
 def _dump(msg) -> bytes:
@@ -133,6 +159,25 @@ class ReplicationService:
         #: by discarding the token — recovery rides the control
         #: channel's piggyback, same as the probabilistic path.
         self.partitioned: set[str] = set()
+        #: Fencing latch (server/election.py): set once this service
+        #: learns a higher leadership epoch exists — an RPC stamped
+        #: with a newer epoch, or the election layer deposing it
+        #: directly.  A deposed leader's forwarded writes bounce with
+        #: a typed EPOCH_FENCED error instead of being applied to (and
+        #: acked from) a history the quorum has moved past.
+        self.deposed = False
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.db, 'epoch', 0)
+
+    def depose(self, epoch: int | None = None) -> None:
+        """Fence this service: a newer leader exists.  Forwarded
+        writes from here on bounce with EPOCH_FENCED."""
+        self.deposed = True
+        log.warning('replication service deposed (epoch %d%s)',
+                    self.epoch,
+                    '' if epoch is None else ' -> %d' % (epoch,))
 
     async def start(self) -> 'ReplicationService':
         self._server = await asyncio.start_server(
@@ -193,7 +238,7 @@ class ReplicationService:
         for h in self._handles.values():
             base, entries = self._entries_from(h.shipped)
             if entries:
-                self._push(h, ('commit', base, entries))
+                self._push(h, ('commit', base, entries, self.epoch))
                 h.shipped = base + len(entries)
                 if trace is not None:
                     # one push span per follower, keyed by the newest
@@ -206,7 +251,7 @@ class ReplicationService:
 
     def _push_expiry(self, session_id: int) -> None:
         for h in self._handles.values():
-            self._push(h, ('session_expired', session_id))
+            self._push(h, ('session_expired', session_id, self.epoch))
 
     # -- per-follower connections --
 
@@ -254,7 +299,7 @@ class ReplicationService:
                             h, have_zxid)
                         if pos is not None:
                             h.applied = h.shipped = pos
-                            self._push(h, ('resync', pos))
+                            self._push(h, ('resync', pos, self.epoch))
                             log.info(
                                 'follower %s rejoined by WAL resync '
                                 'at log index %d (recovered zxid %d, '
@@ -264,7 +309,7 @@ class ReplicationService:
                         pos = self.db.attach_replica_at_tail(h)
                         h.applied = h.shipped = pos
                         self._push(h, ('snapshot', self.db.snapshot(),
-                                       pos))
+                                       pos, self.epoch))
                         log.info('follower %s joined late: snapshot '
                                  'at log index %d (zxid %d)', token,
                                  pos, self.db.zxid)
@@ -274,7 +319,7 @@ class ReplicationService:
             # the follower's connect() blocks until this lands: a
             # commit racing the hello would otherwise slip between
             # "connected" and "attached" and never be logged
-            self._push(h, ('attached',))
+            self._push(h, ('attached', self.epoch))
             # ship anything committed before this follower connected
             self._push_commits()
             try:
@@ -316,15 +361,30 @@ class ReplicationService:
                         db.touch_session(sess)
                     continue
                 assert op == 'rpc', op
-                _, seq, method, args, have = msg
-                status, payload = self._dispatch(method, args)
-                if db.wal is not None:
-                    # logged-before-ack across processes too: a
-                    # forwarded write's RPC response is its ack
-                    db.wal.sync_for_flush()
+                _, seq, method, args, have = msg[:5]
+                rpc_epoch = msg[5] if len(msg) > 5 else None
+                if rpc_epoch is not None and rpc_epoch > self.epoch:
+                    # the caller has seen a newer leader than this
+                    # service: it IS deposed, whatever it believed
+                    self.depose(rpc_epoch)
+                if (self.deposed or (rpc_epoch is not None
+                                     and rpc_epoch < self.epoch)) \
+                        and method in ('create', 'delete', 'set_data'):
+                    # epoch fence: a deposed leader must not apply —
+                    # or ack — a forwarded write, and a stale-epoch
+                    # follower's write must bounce until it rejoins
+                    # the current epoch.  Typed, never silent.
+                    status, payload = 'err', 'EPOCH_FENCED'
+                else:
+                    status, payload = self._dispatch(method, args)
+                    if db.wal is not None:
+                        # logged-before-ack across processes too: a
+                        # forwarded write's RPC response is its ack
+                        db.wal.sync_for_flush()
                 base, entries = self._entries_from(have)
                 writer.write(_dump(
-                    ('res', seq, status, payload, base, entries)))
+                    ('res', seq, status, payload, base, entries,
+                     self.epoch)))
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
@@ -374,7 +434,7 @@ class RemoteLeader(EventEmitter):
     the two ``ZKDatabase`` events the server stack subscribes to."""
 
     def __init__(self, host: str, port: int,
-                 have_zxid: int | None = None):
+                 have_zxid: int | None = None, epoch: int = 0):
         super().__init__()
         self.host = host
         self.port = port
@@ -384,6 +444,19 @@ class RemoteLeader(EventEmitter):
         #: (server/persist.py), announced in the events hello so the
         #: leader can ship only the tail instead of a snapshot
         self.have_zxid = have_zxid
+        #: the newest leadership epoch this follower has accepted
+        #: (recovered from its mirror WAL, then adopted upward from
+        #: the stamp on every push / RPC response).  Pushes stamped
+        #: with a LOWER epoch are rejected — the fencing half of
+        #: server/election.py — and counted in ``stale_pushes``.
+        self.epoch = epoch
+        self.stale_pushes = 0
+        #: invoked exactly once when the events channel dies without
+        #: ``close()`` — the follower's leader-loss signal (push-
+        #: channel EOF), what re-enters the election loop
+        self.on_leader_lost = None
+        self._lost_noted = False
+        self._closing = False
         #: the commit-log mirror (never truncated: one local replica)
         self.log: list = []
         self.log_base = 0
@@ -457,6 +530,7 @@ class RemoteLeader(EventEmitter):
         return self
 
     def close(self) -> None:
+        self._closing = True
         if self._events_task is not None:
             self._events_task.cancel()
             self._events_task = None
@@ -467,14 +541,57 @@ class RemoteLeader(EventEmitter):
             self._sock.close()
             self._sock = None
 
+    def _adopt_epoch(self, epoch: int | None) -> bool:
+        """Adopt a push's epoch stamp.  Returns False when the push is
+        STALE (stamped below the epoch this follower has already
+        accepted) and must be rejected — the fencing rule that keeps a
+        deposed leader's late pushes out of the mirror."""
+        if epoch is None:
+            return True
+        if epoch < self.epoch:
+            self.stale_pushes += 1
+            log.warning('rejecting push from stale epoch %d '
+                        '(accepted epoch is %d)', epoch, self.epoch)
+            return False
+        if epoch > self.epoch:
+            with self._mirror_lock:
+                if epoch > self.epoch:
+                    self.epoch = epoch
+                    if self.wal is not None:
+                        # persist the fence — and fsync it, same rule
+                        # as bump_epoch: a restarted follower must
+                        # come back knowing the epoch it had
+                        # accepted, or a stale leader could re-seed
+                        # it.  Epoch changes are rare; the blocking
+                        # sync never rides the per-push hot path.
+                        self.wal.append(('epoch', epoch,
+                                         self.wal.last_zxid))
+                        self.wal.sync_for_flush()
+        return True
+
+    def _note_leader_lost(self) -> None:
+        if self._lost_noted or self._closing:
+            return
+        self._lost_noted = True
+        cb = self.on_leader_lost
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # pragma: no cover - observer bug
+                log.exception('on_leader_lost callback failed')
+
     async def _consume_events(self, reader: asyncio.StreamReader):
         try:
             while True:
                 msg = await _read_msg(reader)
                 if msg[0] == 'commit':
+                    if not self._adopt_epoch(
+                            msg[3] if len(msg) > 3 else None):
+                        continue       # fenced: a stale leader's push
                     self._ingest(msg[1], msg[2])
                     self.emit('committed')
                 elif msg[0] == 'session_expired':
+                    self._adopt_epoch(msg[2] if len(msg) > 2 else None)
                     sess = self.sessions.get(msg[1])
                     if sess is not None:
                         sess.expired = True
@@ -482,6 +599,7 @@ class RemoteLeader(EventEmitter):
                 elif msg[0] == 'snapshot':
                     # always precedes 'attached' on this ordered
                     # socket; the mirror starts at the image's index
+                    self._adopt_epoch(msg[3] if len(msg) > 3 else None)
                     with self._mirror_lock:
                         assert not self.log, 'snapshot after entries'
                         self._snapshot = (msg[1], msg[2])
@@ -490,16 +608,21 @@ class RemoteLeader(EventEmitter):
                     # the leader accepted have_zxid as the catch-up
                     # base: no image — the recovered tree stands and
                     # the mirror starts at the leader's matching index
+                    self._adopt_epoch(msg[2] if len(msg) > 2 else None)
                     with self._mirror_lock:
                         assert not self.log, 'resync after entries'
                         self.resynced = True
                         self.log_base = msg[1]
                 elif msg[0] == 'attached':
+                    self._adopt_epoch(msg[1] if len(msg) > 1 else None)
                     if not self._attached.done():
                         self._attached.set_result(True)
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError):
+        except asyncio.CancelledError:
             pass
+        except (asyncio.IncompleteReadError, ConnectionError):
+            # push-channel EOF: the leader died (or severed us) — the
+            # follower's election trigger (server/election.py)
+            self._note_leader_lost()
 
     def _ingest(self, base: int, entries: list) -> None:
         """Merge a batch of log entries starting at absolute index
@@ -511,7 +634,13 @@ class RemoteLeader(EventEmitter):
         from this mirror's end."""
         with self._mirror_lock:
             end = self.log_end()
-            assert base <= end, (base, end)
+            if base > end:
+                # a gap: an earlier push was dropped — a scheduled
+                # partition window or a stale-epoch rejection
+                # (_adopt_epoch).  A gapped batch cannot be merged;
+                # recovery rides the control channel's piggyback,
+                # which always serves from this mirror's end.
+                return
             tail = entries[end - base:]
             if tail:
                 self.log.extend(tail)
@@ -550,15 +679,27 @@ class RemoteLeader(EventEmitter):
     # -- control-channel RPC --
 
     def _rpc(self, method: str, *args):
-        with self._lock:
-            assert self._sock is not None, 'RemoteLeader not connected'
-            self._seq += 1
-            seq = self._seq
-            self._sock.sendall(_dump(
-                ('rpc', seq, method, args, self.log_end())))
-            res = _recv_msg(self._sock)
-        tag, rseq, status, payload, base, entries = res
+        try:
+            with self._lock:
+                if self._sock is None:
+                    raise ZKLeaderLostError('not connected')
+                self._seq += 1
+                seq = self._seq
+                self._sock.sendall(_dump(
+                    ('rpc', seq, method, args, self.log_end(),
+                     self.epoch)))
+                res = _recv_msg(self._sock)
+        except (ConnectionError, OSError) as e:
+            # the leader process died (or the OS severed the control
+            # channel) with this RPC in flight: its outcome is
+            # unknown.  Surface the typed, outcome-unknown error the
+            # client-side ambiguity accounting classifies — never a
+            # raw EOF that tears the serving connection down.
+            self._note_leader_lost()
+            raise ZKLeaderLostError(str(e)) from e
+        tag, rseq, status, payload, base, entries = res[:6]
         assert tag == 'res' and rseq == seq, res
+        self._adopt_epoch(res[6] if len(res) > 6 else None)
         self._ingest(base, entries)
         if entries:
             self.emit('committed')
@@ -608,7 +749,10 @@ class RemoteLeader(EventEmitter):
         # fire-and-forget: expiry timers live in the leader process
         with self._lock:
             if self._sock is not None:
-                self._sock.sendall(_dump(('touch', sess.id)))
+                try:
+                    self._sock.sendall(_dump(('touch', sess.id)))
+                except (ConnectionError, OSError):
+                    self._note_leader_lost()
 
     def close_session(self, session_id: int) -> None:
         self._rpc('close_session', session_id)

@@ -398,7 +398,16 @@ class ServerConnection:
     def _op_ping(self, pkt: dict) -> None:
         self._reply(pkt['xid'], 'PING')
 
+    def _check_fence(self) -> None:
+        """Epoch fence (server/election.py): a deposed member — one
+        still serving at an epoch the quorum has moved past — must
+        bounce writes with a typed error, never apply them."""
+        fence = self.server.fence
+        if fence is not None and fence():
+            raise ZKOpError('EPOCH_FENCED')
+
     def _op_create(self, pkt: dict) -> None:
+        self._check_fence()
         path = self.db.create(pkt['path'], pkt['data'], pkt['acl'],
                               CreateFlag(pkt['flags']), self.session)
         # a write through this member catches its store up through the
@@ -408,6 +417,7 @@ class ServerConnection:
         self._reply(pkt['xid'], 'CREATE', path=path)
 
     def _op_delete(self, pkt: dict) -> None:
+        self._check_fence()
         self.db.delete(pkt['path'], pkt['version'])
         self.store.catch_up()
         self._reply(pkt['xid'], 'DELETE')
@@ -422,6 +432,7 @@ class ServerConnection:
         self._reply(pkt['xid'], 'GET_DATA', data=data, stat=stat)
 
     def _op_set_data(self, pkt: dict) -> None:
+        self._check_fence()
         stat = self.db.set_data(pkt['path'], pkt['data'], pkt['version'])
         self.store.catch_up()
         self._reply(pkt['xid'], 'SET_DATA', stat=stat)
@@ -639,6 +650,19 @@ class ZKServer:
         self.packets_received = 0
         self.packets_sent = 0
         self.outstanding = 0
+        #: Election plane (server/election.py).  ``role`` is this
+        #: member's current quorum role (leader | follower |
+        #: electing); ``fence`` an optional callable — True while this
+        #: member is deposed at a stale epoch, making every write
+        #: through it bounce with a typed EPOCH_FENCED error instead
+        #: of being applied against history the quorum moved past.
+        #: ``elections`` counts role resolutions on THIS member;
+        #: ``elections_ref`` (set by an ElectionCoordinator) supplies
+        #: the ensemble-wide count the mntr row prefers.
+        self.role = 'leader' if self.store is self.db else 'follower'
+        self.fence = None
+        self.elections = 0
+        self.elections_ref = None
 
     def encode_notification(self, ntype: str, path: str,
                             zxid: int) -> bytes:
@@ -757,6 +781,49 @@ class ZKServer:
     def mode(self) -> str:
         return 'standalone' if self.store is self.db else 'follower'
 
+    def current_epoch(self) -> int:
+        """The leadership epoch this member serves under (the shared
+        database's for in-process members, the mirror's accepted
+        epoch for an OS-process follower)."""
+        return getattr(self.db, 'epoch', 0)
+
+    def elections_total(self) -> int:
+        ref = self.elections_ref
+        return ref.elections if ref is not None else self.elections
+
+    def repoint(self, db, store=None, role: str | None = None) -> None:
+        """Leadership failover (server/election.py): swap this
+        member's backing database/store while the listener keeps its
+        port.  Every accepted connection is closed — its session and
+        watch state belonged to the dead leader; clients reconnect,
+        resume or re-create sessions, and SET_WATCHES re-arms — and
+        the event subscriptions (session expiry, watch-table store
+        listeners, trace wiring) move to the new storage."""
+        for conn in list(self.conns):
+            conn.close()
+        self.conns.clear()
+        self.db.remove_listener('sessionExpired',
+                                self._on_session_expired)
+        self.db = db
+        self.store = store if store is not None else db
+        self.db.on('sessionExpired', self._on_session_expired)
+        if self.watch_table is not None:
+            self.watch_table.rebind_store(self.store)
+        if self.trace is not None:
+            if self.store is self.db:
+                self.db.trace = self.trace
+                wal = getattr(self.db, 'wal', None)
+                if wal is not None:
+                    wal.trace = self.trace
+                    wal.ledger = self.ledger
+            else:
+                self.store.trace = self.trace
+        if role is not None:
+            self.role = role
+        else:
+            self.role = ('leader' if self.store is self.db
+                         else 'follower')
+
     def monitor_stats(self) -> list[tuple[str, object]]:
         """The ``mntr`` key/value inventory (ordered), real-ZK key
         names where an equivalent exists."""
@@ -791,6 +858,9 @@ class ZKServer:
         return [
             ('zk_version', 'zkstream_tpu'),
             ('zk_server_state', self.mode()),
+            ('zk_member_role', self.role),
+            ('zk_epoch', self.current_epoch()),
+            ('zk_elections_total', self.elections_total()),
             ('zk_znode_count', len(self.store.nodes)),
             ('zk_watch_count', self.watch_count()),
             ('zk_outstanding_requests', self.outstanding),
@@ -866,7 +936,10 @@ class ZKEnsemble:
                  wal_dir: str | None = None,
                  durability: str | None = None,
                  collector=None, wal_segment_bytes: int | None = None,
-                 watchtable: bool | None = None):
+                 watchtable: bool | None = None,
+                 election: bool | None = None,
+                 heartbeat_ms: int | None = None,
+                 seed: int | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -891,6 +964,24 @@ class ZKEnsemble:
                                                             lag=lag),
                      watchtable=watchtable, member=str(i))
             for i in range(count)]
+        #: Quorum leader election (server/election.py): on by default;
+        #: ``election=False`` / ``ZKSTREAM_NO_ELECTION=1`` keeps the
+        #: static member-0 leader as the env-gated validator path.
+        #: The coordinator probes leader liveness on a jittered
+        #: backoff and elects the highest (epoch, zxid, member) among
+        #: live, unpartitioned members when a quorum is reachable.
+        from .election import ElectionCoordinator, election_enabled
+        enabled_election = (election_enabled() if election is None
+                            else election)
+        self.election = (ElectionCoordinator(
+            self.servers, self.db, heartbeat_ms=heartbeat_ms,
+            seed=seed, collector=collector)
+            if enabled_election else None)
+
+    @property
+    def leader_idx(self) -> int:
+        """The current leader member's index (0 on the static path)."""
+        return 0 if self.election is None else self.election.leader_idx
 
     def install_faults(self, injector) -> None:
         """Install one seeded FaultInjector on every member (the chaos
@@ -909,12 +1000,16 @@ class ZKEnsemble:
     async def start(self) -> 'ZKEnsemble':
         for s in self.servers:
             await s.start()
+        if self.election is not None:
+            self.election.start()
         return self
 
     async def stop(self) -> None:
         """Full-ensemble death: every member stops and the WAL (when
         configured) is closed — a fresh ZKEnsemble over the same
         ``wal_dir`` is the restart-from-disk path."""
+        if self.election is not None:
+            self.election.stop()
         for s in self.servers:
             await s.stop()
         if self.db.wal is not None:
@@ -925,8 +1020,12 @@ class ZKEnsemble:
 
     async def restart(self, idx: int) -> None:
         """Bring a killed member back on its old port; a rejoining
-        follower first syncs with the leader, like a real one."""
+        follower first syncs with the leader, like a real one — and
+        with election on, an ex-leader rejoins the CURRENT epoch as a
+        follower, never as the leader it once was."""
         await self.servers[idx].restart()
+        if self.election is not None:
+            self.election.note_restart(idx)
 
     def addresses(self) -> list[tuple[str, int]]:
         return [s.address for s in self.servers]

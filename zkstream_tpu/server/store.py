@@ -253,6 +253,13 @@ class ZKDatabase(NodeTree):
     def __init__(self) -> None:
         super().__init__()
         self.sessions: dict[int, ZKServerSession] = {}
+        #: Leadership epoch (server/election.py): a fencing token, not
+        #: a zxid component.  0 until the first election; bumped by the
+        #: winning member (``bump_epoch``), persisted as a WAL control
+        #: record so a restart recovers it, stamped on every
+        #: replication push and forwarded-write ack so stale-epoch
+        #: traffic is rejectable instead of silently merged.
+        self.epoch = 0
         #: The commit log: every mutation, in zxid order, as a
         #: self-contained entry a :class:`ReplicaStore` can replay.
         #: Only kept once a replica attaches — a standalone server
@@ -291,6 +298,25 @@ class ZKDatabase(NodeTree):
 
     def sync_flush(self) -> None:
         """The SYNC op's barrier — trivial on the leader."""
+
+    def bump_epoch(self, epoch: int) -> None:
+        """Adopt a new leadership epoch (the winning member of an
+        election calls this before serving a single write).  The bump
+        is a WAL *control* record — logged and fsynced like a txn so a
+        restarted member recovers the epoch it was fenced at — but it
+        never enters the replication ``log``: replicas learn epochs
+        from the stamp on every push, and control records must not
+        shift the log's index arithmetic."""
+        if epoch <= self.epoch:
+            raise ValueError('epoch must increase: %d -> %d'
+                             % (self.epoch, epoch))
+        self.epoch = epoch
+        if self.wal is not None:
+            self.wal.append(('epoch', epoch, self.zxid))
+            # the fence must be durable before it can be trusted: a
+            # deposed-then-restarted leader that lost the bump would
+            # come back believing its stale epoch
+            self.wal.sync_for_flush()
 
     def attach_replica_at_tail(self, replica) -> int:
         """Attach a replica that is bootstrapped from a snapshot (the
@@ -381,6 +407,7 @@ class ZKDatabase(NodeTree):
         self.sessions.clear()
         self.nodes = rec.nodes
         self.zxid = rec.zxid
+        self.epoch = max(self.epoch, rec.epoch)
         self.log.clear()
         self.log_base = 0
         self.log_start_zxid = rec.zxid
@@ -605,6 +632,13 @@ class ReplicaStore(NodeTree):
                               pickle.dumps(leader.nodes))})
             self.applied = pos
         leader.on('committed', self._on_commit)
+
+    @property
+    def epoch(self) -> int:
+        """The leadership epoch this replica's feed runs at — the
+        leader's (or mirror's) accepted epoch; what a mirror WAL
+        snapshot stamps (server/persist.py format 2)."""
+        return getattr(self.leader, 'epoch', 0)
 
     def _on_commit(self) -> None:
         if self.lag is None:

@@ -151,11 +151,28 @@ class WatchTable:
                 METRIC_FANOUT_TICK,
                 'Per-shard fan-out flush duration (ms)',
                 buckets=TICK_BUCKETS)
-        store = server.store
+        self._store = server.store
+        self._bind_store(self._store)
+
+    def _bind_store(self, store) -> None:
         store.on('created', self._on_created)
         store.on('deleted', self._on_deleted)
         store.on('dataChanged', self._on_data_changed)
         store.on('childrenChanged', self._on_children_changed)
+
+    def rebind_store(self, store) -> None:
+        """Follow the server onto a new backing store (leadership
+        failover repoints a member's db/store — server/election.py).
+        The caller has already closed every connection, so the index
+        is empty; only the event subscription moves."""
+        old = self._store
+        old.remove_listener('created', self._on_created)
+        old.remove_listener('deleted', self._on_deleted)
+        old.remove_listener('dataChanged', self._on_data_changed)
+        old.remove_listener('childrenChanged',
+                            self._on_children_changed)
+        self._store = store
+        self._bind_store(store)
 
     # -- connection membership --
 
