@@ -8,7 +8,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check native bench asan chaos chaos-ensemble obs \
     durability election bench-wal bench-fanout bench-trace \
-    bench-election timeline coverage clean
+    bench-election bench-transport timeline coverage clean
 
 all: check test
 
@@ -75,6 +75,17 @@ bench-election:
 # plane, not this image's 9p filesystem).
 bench-wal:
 	$(PYTHON) bench.py --wal
+
+# Batched-syscall transport envelope: the best available batched
+# backend (io_uring where the kernel has it, the C writev batch
+# otherwise) vs the asyncio validator, paired cells over real kernel
+# sockets at 128/1k/10k connections x write-heavy/fanout with exact
+# sign tests, per-cell syscall counts
+# (zookeeper_flush_syscalls_total) and tick-ledger phase shares
+# (table in PROFILE.md "Transport tier").  Rounds via
+# ZKSTREAM_BENCH_TRANSPORT_ROUNDS; narrow with --conns/--workloads.
+bench-transport: native
+	$(PYTHON) bench.py --transport
 
 # Serving-plane fan-out envelope: the sharded watch table vs the
 # per-connection emitter dispatch (server/watchtable.py), paired

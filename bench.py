@@ -1551,6 +1551,387 @@ def bench_fanout() -> None:
             }), flush=True)
 
 
+#: `bench.py --transport` sweep (the batched-syscall transport-tier
+#: cell family): connections on the box x workload shape.  Real
+#: kernel sockets — the thing being measured IS the syscall layer —
+#: so the 10k cell needs ~2 fds per connection and clamps to the
+#: process's fd limit when necessary.
+TRANSPORT_SCALES = (128, 1000, 10000)
+TRANSPORT_WORKLOADS = ('write', 'fanout')
+
+
+def _transport_fd_clamp(conns: int) -> int:
+    """Largest connection count the fd limit allows (2 fds per conn +
+    headroom for the process's own files)."""
+    try:
+        import resource
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except Exception:
+        return conns
+    ceiling = max(64, (soft - 128) // 2)
+    return min(conns, ceiling)
+
+
+async def transport_cell(conns: int, workload: str, backend: str,
+                         collector=None, events: int | None = None
+                         ) -> dict:
+    """One transport-tier measurement over REAL kernel sockets:
+    ``conns`` raw TCP connections into one server, each holding a
+    session.
+
+    ``workload='write'``: per event every connection sends one
+    pipelined EXISTS and the cell times the all-requests ->
+    all-replies-received window — the reply path's corked flush is
+    what the tier batches.  ``workload='fanout'``: every connection
+    data-watches one hot path; per event one SET_DATA (through
+    connection 0) fans a notification to every other connection via
+    the watch table's shard flushes — the fanout_flush path.
+
+    ``backend`` forces the tier ('uring' | 'mmsg' | 'asyncio' — the
+    paired A/B arms); the cell scrapes
+    ``zookeeper_flush_syscalls_total`` and ``zookeeper_submit_depth``
+    so the syscalls-per-tick claim is measured, not asserted."""
+    import asyncio
+    import selectors
+    import socket
+
+    from zkstream_tpu.protocol.framing import PacketCodec
+    from zkstream_tpu.server import ZKServer
+    from zkstream_tpu.io.transport import METRIC_FLUSH_SYSCALLS, \
+        METRIC_SUBMIT_DEPTH
+
+    loop = asyncio.get_running_loop()
+    srv = await ZKServer(transport=backend, collector=collector
+                         ).start()
+    resolved = ('asyncio' if srv.transport_tier is None
+                else srv.transport_tier.backend)
+    socks: list = []
+    codecs: list = []
+    inbox: dict[int, list] = {}
+    sel = selectors.DefaultSelector()
+    try:
+        # raw non-blocking client sockets: the client side must not
+        # cost an asyncio protocol per connection — the cell measures
+        # the SERVER's outbound tier, the client just drains bytes
+        connect_pkt = {'protocolVersion': 0, 'lastZxidSeen': 0,
+                       'timeOut': 30000, 'sessionId': 0, 'passwd': b''}
+
+        def _dial(i: int) -> None:
+            s = socket.socket()
+            s.setblocking(False)
+            try:
+                s.connect(('127.0.0.1', srv.port))
+            except BlockingIOError:
+                pass
+            socks.append(s)
+            codecs.append(PacketCodec())
+            sel.register(s, selectors.EVENT_READ, i)
+
+        async def send_all(pkt: dict, idxs=None):
+            # encoded per connection so each codec's xid -> opcode
+            # reply map stays correct (the bytes are identical)
+            for i in (range(len(socks)) if idxs is None else idxs):
+                s = socks[i]
+                view = memoryview(codecs[i].encode(dict(pkt)))
+                while view:
+                    try:
+                        n = s.send(view)
+                        view = view[n:]
+                    except (BlockingIOError, OSError):
+                        await asyncio.sleep(0)
+
+        async def recv_frames(need_per_conn: int, idxs=None,
+                              timeout: float = 60.0):
+            """Drain until every polled socket produced
+            ``need_per_conn`` decoded packets; returns per-conn packet
+            lists (handshake replies included on the first call).
+            epoll-driven (selectors) so an idle pass costs one poll,
+            not one recv per connection — the pump must not charge
+            either arm O(conns) per event-loop iteration.  Packets
+            for connections outside ``idxs`` land in the persistent
+            inbox and seed that connection's next wait."""
+            idxs = list(range(len(socks))) if idxs is None else idxs
+            got: dict[int, list] = {i: inbox.pop(i, []) for i in idxs}
+            pendset = {i for i in idxs
+                       if len(got[i]) < need_per_conn}
+            deadline = loop.time() + timeout
+            while pendset:
+                for key, _ev in sel.select(timeout=0):
+                    i = key.data
+                    try:
+                        data = key.fileobj.recv(1 << 16)
+                    except BlockingIOError:
+                        continue
+                    if not data:
+                        raise ConnectionError('conn %d closed' % i)
+                    pkts = codecs[i].decode(data)
+                    if i in got:
+                        got[i].extend(pkts)
+                        if len(got[i]) >= need_per_conn:
+                            pendset.discard(i)
+                    else:
+                        inbox.setdefault(i, []).extend(pkts)
+                if loop.time() > deadline:
+                    raise TimeoutError('%d conns still pending'
+                                       % len(pendset))
+                if pendset:
+                    await asyncio.sleep(0)
+            return got
+
+        async def recv_bytes(targets: dict, timeout: float = 60.0):
+            """The timed pump: count bytes per connection against
+            ``targets`` (conn -> expected bytes) — every reply and
+            notification frame in the timed phases has a fixed wire
+            size, so tallying lengths verifies delivery without
+            charging the window a Python frame decode per packet
+            (which would dilute the A/B delta with equal-cost
+            work)."""
+            remaining = dict(targets)
+            pend = len(remaining)
+            deadline = loop.time() + timeout
+            while pend:
+                for key, _ev in sel.select(timeout=0):
+                    i = key.data
+                    try:
+                        data = key.fileobj.recv(1 << 16)
+                    except BlockingIOError:
+                        continue
+                    if not data:
+                        raise ConnectionError('conn %d closed' % i)
+                    r = remaining.get(i)
+                    if r is None or r <= 0:
+                        continue
+                    r -= len(data)
+                    remaining[i] = r
+                    if r <= 0:
+                        pend -= 1
+                if loop.time() > deadline:
+                    raise TimeoutError('%d conns still pending'
+                                       % pend)
+                if pend:
+                    await asyncio.sleep(0)
+
+        # dial + handshake in waves bounded by the server's listen
+        # backlog, so a 10k-conn cell can't overflow the accept queue
+        wave = min(conns, 512)
+        done = 0
+        while done < conns:
+            n = min(wave, conns - done)
+            for i in range(done, done + n):
+                _dial(i)
+            await asyncio.sleep(0)
+            await send_all(connect_pkt, idxs=range(done, done + n))
+            hs = await recv_frames(1, idxs=list(range(done, done + n)))
+            for i, pkts in hs.items():
+                assert pkts[0]['sessionId'] != 0
+                codecs[i].handshaking = False
+            done += n
+
+        from zkstream_tpu.protocol.consts import CreateFlag
+        srv.db.create('/hot', b'z' * 64, [], CreateFlag(0))
+
+        if events is None:
+            events = max(4, min(40, 80000 // max(conns, 1)))
+        lat_ms: list[float] = []
+        xid = [0]
+
+        def req(pkt):
+            xid[0] += 1
+            return dict(pkt, xid=xid[0])
+
+        async def probe_len(pkt) -> int:
+            """One frame's wire size, measured on conn 0 (every timed
+            frame is fixed-width: int64 zxids, constant path/data)."""
+            await send_all(req(pkt), idxs=[0])
+            buf = b''
+            while len(buf) < 4 or \
+                    len(buf) < 4 + int.from_bytes(buf[:4], 'big'):
+                try:
+                    buf += socks[0].recv(1 << 16)
+                except BlockingIOError:
+                    await asyncio.sleep(0)
+            return 4 + int.from_bytes(buf[:4], 'big')
+
+        if workload == 'write':
+            reply_len = await probe_len({'opcode': 'EXISTS',
+                                         'path': '/hot',
+                                         'watch': False})
+            for _ in range(events):
+                frame = req({'opcode': 'EXISTS', 'path': '/hot',
+                             'watch': False})
+                t0 = loop.time()
+                await send_all(frame)
+                await recv_bytes({i: reply_len
+                                  for i in range(len(socks))})
+                lat_ms.append((loop.time() - t0) * 1000.0)
+        else:
+            watchers = list(range(1, len(socks)))
+            arm_len = await probe_len({'opcode': 'GET_DATA',
+                                       'path': '/hot',
+                                       'watch': False})
+            set_len = await probe_len({'opcode': 'SET_DATA',
+                                       'path': '/hot',
+                                       'data': b'z' * 64,
+                                       'version': -1})
+            notif_len = len(srv.encode_notification(
+                'DATA_CHANGED', '/hot', 1))
+            fan_targets = {w: notif_len for w in watchers}
+            fan_targets[0] = set_len
+            for ev in range(events):
+                await send_all(req({'opcode': 'GET_DATA',
+                                    'path': '/hot', 'watch': True}),
+                               idxs=watchers)
+                await recv_bytes({w: arm_len for w in watchers})
+                t0 = loop.time()
+                await send_all(req({'opcode': 'SET_DATA',
+                                    'path': '/hot',
+                                    'data': b'z' * 64,
+                                    'version': -1}), idxs=[0])
+                # each watcher: one notification; conn 0: the reply
+                await recv_bytes(dict(fan_targets))
+                lat_ms.append((loop.time() - t0) * 1000.0)
+    finally:
+        sel.close()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        await srv.stop()
+        if srv.ledger is not None:
+            srv.ledger.close_tick()
+    p50, p99 = _percentiles(lat_ms)
+    out = {'conns': conns, 'workload': workload,
+           'backend': backend, 'resolved_backend': resolved,
+           'events': events,
+           'event_ms_mean': round(sum(lat_ms) / len(lat_ms), 3),
+           'event_ms_p50': round(p50, 3),
+           'event_ms_p99': round(p99, 3)}
+    if collector is not None:
+        try:
+            ctr = collector.get_collector(METRIC_FLUSH_SYSCALLS)
+        except ValueError:
+            ctr = None
+        if ctr is not None:
+            # exact series: {plane, backend} -> count
+            sys_by_backend = {}
+            for key in ctr.label_keys():
+                labels = dict(key)
+                if labels.get('plane') == 'server':
+                    sys_by_backend[labels.get('backend', '?')] = \
+                        ctr.value(labels)
+            out['server_syscalls'] = sys_by_backend
+            total = sum(sys_by_backend.values())
+            out['syscalls_per_event'] = round(total / max(1, events), 2)
+        try:
+            dep = collector.get_collector(METRIC_SUBMIT_DEPTH)
+        except ValueError:
+            dep = None
+        if dep is not None and resolved != 'asyncio':
+            labels = {'plane': 'server', 'backend': resolved}
+            n = dep.count(labels)
+            if n:
+                out['submit_depth'] = {
+                    'submissions': n,
+                    'mean': round(dep.sum(labels) / n, 1),
+                    'p99': round(dep.percentile(99, labels), 1)}
+        from zkstream_tpu.utils.metrics import scrape_tick_cells
+        tick = scrape_tick_cells(collector)
+        if tick:
+            out['tick_ledger'] = tick
+    return out
+
+
+def bench_transport() -> None:
+    """The batched-syscall transport envelope (`make bench-transport`):
+    paired batched-vs-asyncio cells over the conns x workload sweep
+    (128/1k/10k x write-heavy/fanout), per-round adjacent A/B runs,
+    exact two-sided sign test on the per-event latency — the PROFILE.md
+    methodology, same as the cork/WAL/fan-out families.  The syscall
+    reduction is printed per cell from
+    ``zookeeper_flush_syscalls_total`` (O(dirty conns) -> O(1) per
+    tick on the uring path).  Scale with ZKSTREAM_BENCH_TRANSPORT_ROUNDS;
+    narrow with ``--conns`` / ``--workloads`` comma-lists."""
+    import asyncio
+
+    from zkstream_tpu.io.transport import probe
+    from zkstream_tpu.utils.metrics import Collector, sign_test_p
+
+    p = probe()
+    batched = 'uring' if p.uring else ('mmsg' if p.mmsg else None)
+    if batched is None:
+        print('# no batched transport backend available on this '
+              'platform (uring: %s; mmsg: %s) — nothing to pair'
+              % (p.uring_reason, p.mmsg_reason), file=sys.stderr)
+        return
+    print('# transport probe: %s (pairing %s vs asyncio)'
+          % (p, batched), file=sys.stderr)
+    conns_sweep = _arg_ints('--conns') or list(TRANSPORT_SCALES)
+    workloads = TRANSPORT_WORKLOADS
+    if '--workloads' in sys.argv:
+        idx = sys.argv.index('--workloads')
+        if idx + 1 < len(sys.argv):
+            workloads = tuple(w for w in sys.argv[idx + 1].split(',')
+                              if w)
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_TRANSPORT_ROUNDS',
+                                '10'))
+    rows: dict = {}
+    cells: dict = {}
+    for rnd in range(rounds):
+        for conns in conns_sweep:
+            clamped = _transport_fd_clamp(conns)
+            if clamped < conns:
+                if rnd == 0:
+                    print('# transport cell %d clamped to %d conns '
+                          '(fd limit)' % (conns, clamped),
+                          file=sys.stderr)
+            for wl in workloads:
+                pair = {}
+                for backend in (batched, 'asyncio'):
+                    col = Collector()
+                    try:
+                        pair[backend] = asyncio.run(transport_cell(
+                            clamped, wl, backend, collector=col))
+                    except Exception as e:
+                        print('# transport cell %dx%s %s round '
+                              'failed: %r' % (clamped, wl, backend, e),
+                              file=sys.stderr)
+                for backend, r in pair.items():
+                    key = (conns, wl, backend)
+                    if len(pair) == 2:
+                        rows.setdefault(key, []).append(
+                            r['event_ms_mean'])
+                    if key not in cells or r['event_ms_mean'] < \
+                            cells[key]['event_ms_mean']:
+                        cells[key] = r
+    for key in sorted(cells, key=str):
+        print('# transport_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for conns in conns_sweep:
+        for wl in workloads:
+            a = rows.get((conns, wl, batched), [])
+            b = rows.get((conns, wl, 'asyncio'), [])
+            if not a or not b:
+                continue
+            paired = list(zip(a, b))
+            # positive delta = batched faster (lower latency)
+            deltas = [(y - x) / y * 100.0 for x, y in paired if y]
+            wins = sum(1 for x, y in paired if x < y)
+            losses = sum(1 for x, y in paired if x > y)
+            print(json.dumps({
+                'metric': 'transport_backend_sign_test',
+                'conns': conns,
+                'workload': wl,
+                'backend': batched,
+                'rounds': len(paired),
+                'wins': wins,
+                'losses': losses,
+                'mean_delta_pct': round(sum(deltas)
+                                        / max(1, len(deltas)), 1),
+                'sign_p': round(sign_test_p(wins, losses), 4),
+            }), flush=True)
+
+
 def _guard_backend(timeout_s: float | None = None) -> None:
     """Probe the default JAX backend in a SUBPROCESS before this
     process touches jax: a wedged tunneled-TPU backend has been
@@ -1639,6 +2020,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_trace_overhead()
+        return
+    if '--transport' in sys.argv:
+        # `make bench-transport`: the batched-syscall transport-tier
+        # cell family (io/transport.py: uring/mmsg vs the asyncio
+        # validator) over real kernel sockets.  Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_transport()
         return
     if '--fanout' in sys.argv:
         # `make bench-fanout`: the serving-plane fan-out cell family

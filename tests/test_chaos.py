@@ -111,6 +111,17 @@ def test_campaign_runs_with_watchtable_enabled():
         'ZKSTREAM_NO_WATCHTABLE must not be set for the tier-1 campaign'
 
 
+def test_campaign_runs_on_default_transport():
+    # same rationale for the batched-syscall transport tier
+    # (io/transport.py): the campaign must run the capability-probe
+    # default, so the env force must be UNSET (probe().chosen folds
+    # the force in, so comparing against it would pass any resolved
+    # force) — forced-backend slices live in tests/test_transport.py
+    import os
+    assert os.environ.get('ZKSTREAM_TRANSPORT') in (None, ''), \
+        'ZKSTREAM_TRANSPORT must not be set for the tier-1 campaign'
+
+
 @pytest.mark.timeout(240)
 @pytest.mark.parametrize('batch', range(BATCHES))
 async def test_chaos_campaign(batch):

@@ -335,6 +335,18 @@ def test_campaign_runs_with_watchtable_enabled():
         'ZKSTREAM_NO_WATCHTABLE must not be set for the tier-1 campaign'
 
 
+def test_campaign_runs_on_default_transport():
+    """Same rationale for the batched-syscall transport tier
+    (io/transport.py): a stray ZKSTREAM_TRANSPORT must not silently
+    rebase what these campaigns certify, so the env force must be
+    UNSET (``probe().chosen`` folds the force in — comparing against
+    it would pass any resolved force).  The forced-backend slices
+    live in tests/test_transport.py."""
+    import os
+    assert os.environ.get('ZKSTREAM_TRANSPORT') in (None, ''), \
+        'ZKSTREAM_TRANSPORT must not be set for the tier-1 campaign'
+
+
 @pytest.mark.timeout(90)
 async def test_kill_recover_rides_every_schedule():
     """The durability plane's kill/recover pass (invariant 6) runs

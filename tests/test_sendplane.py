@@ -170,3 +170,89 @@ async def test_chaos_slice_cork_disabled(monkeypatch):
     for seed in range(140, 146):
         res = await run_schedule(seed)
         assert res.ok, (seed, res.violations)
+
+
+# -- the early-flush cap knob (ZKSTREAM_FLUSH_CAP / flush_cap=) --------
+
+async def test_flush_cap_env_default(monkeypatch):
+    from zkstream_tpu.io.sendplane import (
+        DEFAULT_MAX_CORK,
+        flush_cap_default,
+    )
+    monkeypatch.delenv('ZKSTREAM_FLUSH_CAP', raising=False)
+    assert flush_cap_default() == DEFAULT_MAX_CORK
+    monkeypatch.setenv('ZKSTREAM_FLUSH_CAP', '1024')
+    assert flush_cap_default() == 1024
+    plane = SendPlane(lambda d: None, enabled=True)
+    assert plane.max_bytes == 1024          # resolved at construction
+    for junk in ('nope', '-5', '0'):
+        monkeypatch.setenv('ZKSTREAM_FLUSH_CAP', junk)
+        assert flush_cap_default() == DEFAULT_MAX_CORK
+
+
+async def test_flush_cap_knobs_reach_both_planes():
+    """Client(flush_cap=) and ZKServer(flush_cap=) resize the per-
+    connection planes (the 256 KiB constant was the only option
+    before)."""
+    from zkstream_tpu.io.connection import Backend, ZKConnection
+    from zkstream_tpu.server.server import ServerConnection
+
+    srv = ZKServer(flush_cap=123)
+
+    class _W:            # writer stub: the plane only needs .write
+        transport = None
+
+        def write(self, data):
+            pass
+    conn = ServerConnection(srv, reader=None, writer=_W())
+    assert conn._tx.max_bytes == 123
+
+    client = Client(address='127.0.0.1', port=1, flush_cap=77)
+    zc = ZKConnection(client, Backend('127.0.0.1', 1))
+    assert zc._tx.max_bytes == 77
+
+
+async def test_flush_cap_honored_per_backend():
+    """A burst over the cap leaves the plane immediately on EVERY
+    backend: the legacy path writes it, a batched tier takes it into
+    the tick submission — the plane never holds more than the cap."""
+    from zkstream_tpu.io.transport import probe
+
+    # asyncio (no tier): early flush reaches the sink synchronously
+    writes: list[bytes] = []
+    plane = SendPlane(writes.append, enabled=True, max_bytes=8)
+    plane.send(b'aaaa')
+    plane.send(b'bbbb')
+    assert writes == [b'aaaabbbb'] and plane.pending == 0
+
+    batched = [b for b in ('uring', 'mmsg') if probe().available(b)]
+    if not batched:
+        return
+    import socket
+
+    from zkstream_tpu.io.transport import TransportTier
+    left, right = socket.socketpair()
+    left.setblocking(False)
+    right.setblocking(False)
+    loop = asyncio.get_running_loop()
+    transport, _ = await loop.create_connection(asyncio.Protocol,
+                                                sock=left)
+    try:
+        tier = TransportTier(batched[0])
+        plane = SendPlane(transport.write, enabled=True, max_bytes=8,
+                          tier=tier, transport_fn=lambda: transport)
+        plane.send(b'aaaa')
+        assert plane.pending == 4
+        plane.send(b'bbbb')              # cap hit: plane hands off now
+        assert plane.pending == 0
+        await asyncio.sleep(0)           # the tick submission
+        data = b''
+        while len(data) < 8:
+            try:
+                data += right.recv(64)
+            except BlockingIOError:
+                await asyncio.sleep(0)
+        assert data == b'aaaabbbb'
+    finally:
+        transport.close()
+        right.close()

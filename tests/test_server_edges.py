@@ -132,7 +132,10 @@ async def test_request_on_expired_session_and_unimplemented_op(server):
 
     dec.xid_map[7] = 'PING'      # as the send side would have recorded
     conn._handle_request({'xid': 7, 'opcode': 'PING'})
-    conn._tx.flush_now()         # replies are tick-corked (sendplane)
+    # replies are tick-corked (sendplane); flush_hard is the
+    # synchronous drain on every transport backend (flush_now defers
+    # to the batched tier's tick callback when one is attached)
+    conn._tx.flush_hard()
     (reply,) = dec.decode(sent.pop())
     assert reply['err'] == 'SESSION_EXPIRED'
 
@@ -140,12 +143,12 @@ async def test_request_on_expired_session_and_unimplemented_op(server):
     # an opcode with no _op_ handler: UNIMPLEMENTED, not a crash
     dec.xid_map[8] = 'CHECK_WATCHES'
     conn._handle_request({'xid': 8, 'opcode': 'CHECK_WATCHES'})
-    conn._tx.flush_now()
+    conn._tx.flush_hard()
     (reply,) = dec.decode(sent.pop())
     assert reply['err'] == 'UNIMPLEMENTED'
 
     conn._handle_request({'xid': -2, 'opcode': 'PING'})
-    conn._tx.flush_now()
+    conn._tx.flush_hard()
     (reply,) = dec.decode(sent.pop())
     assert reply['err'] == 'OK'
 

@@ -375,6 +375,17 @@ def build_parser() -> argparse.ArgumentParser:
                          '(ZKSTREAM_NO_ELECTION=1) — bisects whether '
                          'a failing seed implicates the election '
                          'plane (server/election.py)')
+    ch.add_argument('--transport',
+                    choices=('uring', 'mmsg', 'asyncio'),
+                    default=None,
+                    help='rerun on a forced transport backend '
+                         '(io/transport.py; ZKSTREAM_TRANSPORT) — '
+                         'bisects whether a failing seed implicates '
+                         'the batched-syscall tier.  Forcing an '
+                         'unavailable backend falls DOWN the '
+                         'uring>mmsg>asyncio order, so the rerun '
+                         'still executes (the summary names the '
+                         'resolved backend)')
     ch.add_argument('--trace-out', metavar='PATH', default=None,
                     help='write every schedule\'s xid-correlated span '
                          'dump — member kill/restart events included '
@@ -445,6 +456,13 @@ async def _chaos(args) -> int:
         os.environ['ZKSTREAM_NO_WATCHTABLE'] = '1'
     if getattr(args, 'no_election', False):
         os.environ['ZKSTREAM_NO_ELECTION'] = '1'
+    if getattr(args, 'transport', None):
+        # the schedule servers/clients resolve their backend from the
+        # env at construction (io/transport.py); part of the rerun key
+        os.environ['ZKSTREAM_TRANSPORT'] = args.transport
+        from .io.transport import backend_default
+        print('# transport backend forced: %s (resolved: %s)'
+              % (args.transport, backend_default()))
 
     def progress(r):
         if args.quiet and r.ok:

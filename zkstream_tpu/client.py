@@ -82,7 +82,9 @@ class Client(FSM):
                  faults=None,
                  trace: TraceRing | None = None,
                  trace_capacity: int = 256,
-                 cork: bool | None = None):
+                 cork: bool | None = None,
+                 transport: str | None = None,
+                 flush_cap: int | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -118,6 +120,9 @@ class Client(FSM):
         #: ZKSTREAM_NO_CORK=1), True/False force a path (benchmarks,
         #: A/B tests).
         self.cork = cork
+        #: Early-flush cap override for this client's send planes
+        #: (None = ZKSTREAM_FLUSH_CAP / the 256 KiB default).
+        self.flush_cap = flush_cap
         #: Optional crash-on-bug policy override: called with the
         #: exception after session teardown instead of the loud default
         #: (loop exception handler).  See ZKSession.fatal_error.
@@ -131,6 +136,15 @@ class Client(FSM):
         self.op_timeout = op_timeout
 
         self.collector = collector if collector is not None else Collector()
+        #: Batched-syscall transport tier for this client's
+        #: connections (io/transport.py): None when the resolved
+        #: backend is 'asyncio' (the legacy per-plane writes).
+        #: ``transport=`` forces a tier ('uring'|'mmsg'|'asyncio');
+        #: None = the ZKSTREAM_TRANSPORT / capability-probe default.
+        from .io.transport import make_tier
+        self.transport_tier = make_tier(transport,
+                                        collector=self.collector,
+                                        plane='client')
         self.collector.counter(METRIC_ZK_EVENT_COUNTER,
             'Total number of zookeeper events')
         #: Per-op latency distribution, labelled by opcode; recorded by
@@ -242,6 +256,11 @@ class Client(FSM):
         self.once('close', lambda: fut.done() or fut.set_result(None))
         self.emit('closeAsserted')
         await fut
+        if self.transport_tier is not None:
+            # release the tier's ring fd with the client instead of
+            # waiting on cyclic GC (the plane/entry closures keep the
+            # tier in a cycle); a reused client lazily re-creates it
+            self.transport_tier.close()
 
     # -- session management (reference: lib/client.js:187-273) --
 

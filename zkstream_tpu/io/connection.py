@@ -149,12 +149,20 @@ class ZKConnection(FSM):
         self._connect_t0: float | None = None
         #: Outbound cork (io/sendplane.py): every encoded frame goes
         #: through it; frames of one event-loop tick leave as a single
-        #: transport.write.  ``client.cork`` forces it on/off (None =
-        #: process default, see sendplane.cork_default).
+        #: transport.write — or, when the client carries a batched
+        #: transport tier (io/transport.py), as part of the tick's one
+        #: batched submission.  ``client.cork`` forces the cork on/off
+        #: (None = process default, see sendplane.cork_default);
+        #: ``client.flush_cap`` resizes the early-flush cap.
         collector = getattr(client, 'collector', None)
         self._tx = SendPlane(self._tx_write,
                              enabled=getattr(client, 'cork', None),
-                             collector=collector, plane='client')
+                             max_bytes=getattr(client, 'flush_cap',
+                                               None),
+                             collector=collector, plane='client',
+                             tier=getattr(client, 'transport_tier',
+                                          None),
+                             transport_fn=lambda: self.transport)
         self._connect_latency = None
         if collector is not None:
             self._connect_latency = collector.histogram(
@@ -431,8 +439,10 @@ class ZKConnection(FSM):
             self.log.info('sent CLOSE_SESSION request (xid %d)',
                           close_xid[0])
             self._write({'opcode': 'CLOSE_SESSION', 'xid': close_xid[0]})
-            # the EOF must not cut ahead of the corked CLOSE_SESSION
-            self._tx.flush_now()
+            # the EOF must not cut ahead of the corked CLOSE_SESSION —
+            # hard: a batched transport tier defers flush_now to the
+            # tick submission, which would land after the write_eof
+            self._tx.flush_hard()
             try:
                 if self.transport and self.transport.can_write_eof():
                     self.transport.write_eof()
@@ -555,8 +565,10 @@ class ZKConnection(FSM):
                 # A fault fired on this frame.  Its scheduled reset
                 # lands next tick — deliver everything already corked
                 # plus the truncated frame NOW, in stream order, so
-                # the reset still targets exactly this frame.
-                self._tx.flush_now()
+                # the reset still targets exactly this frame (hard:
+                # the batched transport tier must drain synchronously
+                # or the direct write below would overtake it).
+                self._tx.flush_hard()
                 self._tx_write(out)
                 return
         if self.transport is None:

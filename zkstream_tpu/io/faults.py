@@ -380,7 +380,11 @@ class FaultInjector:
         or the stream would reorder in a way TCP never does.  The hook
         itself stays a per-frame boundary — injection happens before
         the cork (send plane AND shard cork alike), and a faulted
-        frame bypasses both."""
+        frame bypasses both.  This holds on every transport backend
+        (io/transport.py): ``flush_hard`` drains the batched tier's
+        pending submission for the connection synchronously, so the
+        gate's direct ``writer.write`` deliveries can never overtake
+        bytes the tier still held."""
         cfg = self.config
         wants_reset = self._take('server_tx', cfg.p_server_tx_reset,
                                  'server tx mid-frame reset')

@@ -37,6 +37,19 @@ server/watchtable.py), scraped by bench.py write-heavy cells,
 ``ZKSTREAM_NO_CORK=1`` (or ``cork=False`` on Client / ZKServer)
 degrades to write-through — every frame still flows through the plane
 (and the histograms), it just flushes per frame.
+
+Beneath the plane sits the batched-syscall transport tier
+(io/transport.py, ``ZKSTREAM_TRANSPORT=uring|mmsg|asyncio``): when a
+tier is attached, a flush hands its chunk list to the tier's
+per-tick submission queue instead of joining and writing — one
+io_uring submission (or one C writev batch) then covers EVERY dirty
+connection of the tick.  The plane's contracts are tier-independent:
+``flush_hard`` still puts bytes on the wire before returning (the
+tier drains that entry synchronously), the durability barrier still
+gates BEFORE bytes reach any queue, and a disabled cork bypasses the
+tier entirely (the frame-per-syscall validator).  The
+``ZKSTREAM_FLUSH_CAP`` env (``flush_cap=`` on Client / ZKServer)
+resizes the early-flush cap.
 """
 
 from __future__ import annotations
@@ -44,6 +57,7 @@ from __future__ import annotations
 import os
 
 from ..utils.aio import ambient_loop
+from .transport import METRIC_FLUSH_SYSCALLS
 
 METRIC_FLUSH_FRAMES = 'zookeeper_flush_batch_frames'
 METRIC_FLUSH_BYTES = 'zookeeper_flush_batch_bytes'
@@ -65,6 +79,16 @@ def cork_default() -> bool:
     return os.environ.get('ZKSTREAM_NO_CORK') != '1'
 
 
+def flush_cap_default() -> int:
+    """The early-flush cap for new planes: ``ZKSTREAM_FLUSH_CAP``
+    (bytes) when set and positive, else :data:`DEFAULT_MAX_CORK`."""
+    try:
+        v = int(os.environ.get('ZKSTREAM_FLUSH_CAP', ''))
+    except ValueError:
+        return DEFAULT_MAX_CORK
+    return v if v > 0 else DEFAULT_MAX_CORK
+
+
 class SendPlane:
     """One connection's outbound cork.
 
@@ -75,13 +99,24 @@ class SendPlane:
 
     __slots__ = ('_write', '_chunks', '_pending', '_scheduled',
                  'enabled', 'max_bytes', '_frames_hist', '_bytes_hist',
-                 '_labels', '_barrier', '_ledger')
+                 '_labels', '_barrier', '_ledger', '_tier', '_entry',
+                 '_syscall_ctr')
 
     def __init__(self, write, *, enabled: bool | None = None,
-                 max_bytes: int = DEFAULT_MAX_CORK,
+                 max_bytes: int | None = None,
                  collector=None, plane: str = 'client',
-                 barrier=None, ledger=None):
+                 barrier=None, ledger=None,
+                 tier=None, transport_fn=None):
         self._write = write
+        #: Optional io/transport.TransportTier + the live-transport
+        #: accessor it resolves an fd from: flushed chunk lists defer
+        #: to the tier's per-tick batched submission instead of being
+        #: joined and written here.  The cork kill switch bypasses it
+        #: (write-through means frame-per-syscall, the validator).
+        self._tier = tier
+        self._entry = (tier.channel(write, transport_fn)
+                       if tier is not None and transport_fn is not None
+                       else None)
         #: Optional utils/metrics.TickLedger (server planes): flush
         #: time lands in the ``cork_flush`` tick phase, loop-blocking
         #: barrier time in ``fsync_gate``.
@@ -103,9 +138,11 @@ class SendPlane:
         self._pending = 0
         self._scheduled = False
         self.enabled = cork_default() if enabled is None else enabled
-        self.max_bytes = max_bytes
+        self.max_bytes = (flush_cap_default() if max_bytes is None
+                          else max_bytes)
         self._frames_hist = None
         self._bytes_hist = None
+        self._syscall_ctr = None
         self._labels = {'plane': plane}
         if collector is not None:
             self._frames_hist = collector.histogram(
@@ -116,6 +153,10 @@ class SendPlane:
                 METRIC_FLUSH_BYTES,
                 'Bytes per coalesced transport write, by plane',
                 buckets=BYTE_BUCKETS)
+            self._syscall_ctr = collector.counter(
+                METRIC_FLUSH_SYSCALLS,
+                'Write submissions issued by the outbound plane, by '
+                'plane and backend')
 
     @property
     def pending(self) -> int:
@@ -128,6 +169,7 @@ class SendPlane:
         if not self.enabled:
             if self._barrier is None:
                 self._observe(1, len(data))
+                self._count_legacy()
                 self._write(data)
                 return
             # write-through still rides the gate: the frame corks for
@@ -143,7 +185,14 @@ class SendPlane:
             return
         if not self._scheduled:
             self._scheduled = True
-            ambient_loop().call_soon(self._tick_flush)
+            if self._entry is not None:
+                # a transport tier owns the tick boundary: ONE loop
+                # callback flushes every registered plane and submits
+                # the whole batch (instead of one call_soon per
+                # connection per tick)
+                self._tier.schedule_flush(self)
+            else:
+                ambient_loop().call_soon(self._tick_flush)
 
     def _tick_flush(self) -> None:
         self._scheduled = False
@@ -190,7 +239,10 @@ class SendPlane:
     def flush_hard(self) -> None:
         """Barrier taken synchronously, bytes written before return —
         for paths where later writes must not overtake (fault-injected
-        delivery, CLOSE_SESSION ahead of EOF, connection close)."""
+        delivery, CLOSE_SESSION ahead of EOF, connection close).  With
+        a transport tier attached the entry's pending bytes are
+        submitted on the spot (single-entry submission), so the
+        synchronous contract holds on every backend."""
         if self._barrier is not None:
             led = self._ledger
             if led is not None:
@@ -201,10 +253,15 @@ class SendPlane:
                     led.exit()
             else:
                 self._barrier.sync_for_flush()
-        self._write_out()
+        self._write_out(hard=True)
 
-    def _write_out(self) -> None:
+    def _write_out(self, hard: bool = False) -> None:
         if not self._chunks:
+            # a hard flush must still drain bytes an earlier flush
+            # (cap hit, barrier release) parked in the tier entry —
+            # or a direct write issued right after would overtake them
+            if hard and self._entry is not None:
+                self._tier.drain(self._entry)
             return
         chunks = self._chunks
         n = len(chunks)
@@ -212,27 +269,46 @@ class SendPlane:
         self._chunks = []
         self._pending = 0
         self._observe(n, size)
+        entry = self._entry
+        if entry is not None and self.enabled:
+            # deferred to the tier's tick submission (one batched
+            # syscall chain covering every dirty connection); the
+            # tier accounts the syscalls and the ledger's cork_flush.
+            # A hard flush drains this entry synchronously instead.
+            self._tier.enqueue(entry, chunks, size)
+            if hard:
+                self._tier.drain(entry)
+            return
+        self._count_legacy()
         led = self._ledger
+        data = chunks[0] if n == 1 else b''.join(chunks)
         if led is not None:
             led.enter('cork_flush')
             try:
-                self._write(chunks[0] if n == 1
-                            else b''.join(chunks))
+                self._write(data)
             finally:
                 led.exit()
         else:
-            self._write(chunks[0] if n == 1 else b''.join(chunks))
+            self._write(data)
 
     def reset(self) -> None:
         """Drop corked frames without writing (connection aborted:
-        the bytes have nowhere to go)."""
+        the bytes have nowhere to go) — anything already deferred to
+        the transport tier goes with them."""
         self._chunks = []
         self._pending = 0
+        if self._entry is not None:
+            self._tier.discard(self._entry)
 
     def _observe(self, frames: int, nbytes: int) -> None:
         if self._frames_hist is not None:
             self._frames_hist.observe(frames, self._labels)
             self._bytes_hist.observe(nbytes, self._labels)
+
+    def _count_legacy(self) -> None:
+        if self._syscall_ctr is not None:
+            self._syscall_ctr.increment(
+                {'plane': self._labels['plane'], 'backend': 'asyncio'})
 
 
 def scrape_flush_cells(collector) -> dict:

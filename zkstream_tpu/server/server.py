@@ -84,16 +84,24 @@ class ServerConnection:
         self._admin_checked = False
         #: Outbound cork (io/sendplane.py): replies and notifications
         #: of one event-loop tick leave as a single writer.write (a
-        #: pipelined request batch is answered with one segment).
-        #: When the leader database carries a WAL, the plane gates on
-        #: it: corked acks wait (in order) for the off-loop group
-        #: fsync covering their txns, so no ack byte reaches the
-        #: transport before its txn is on disk and the event loop
-        #: never blocks on the device (server/persist.py sync='tick').
+        #: pipelined request batch is answered with one segment) —
+        #: or, when the server carries a batched transport tier
+        #: (io/transport.py), as this connection's slice of the
+        #: tick's ONE batched submission across every dirty
+        #: connection.  When the leader database carries a WAL, the
+        #: plane gates on it: corked acks wait (in order) for the
+        #: off-loop group fsync covering their txns, so no ack byte
+        #: reaches the transport before its txn is on disk and the
+        #: event loop never blocks on the device (server/persist.py
+        #: sync='tick').
         self._tx = SendPlane(self._tx_write, enabled=server.cork,
+                             max_bytes=server.flush_cap,
                              collector=server.collector, plane='server',
                              barrier=getattr(server.db, 'wal', None),
-                             ledger=server.ledger)
+                             ledger=server.ledger,
+                             tier=server.transport_tier,
+                             transport_fn=lambda: getattr(
+                                 self.writer, 'transport', None))
 
     # -- wire helpers --
 
@@ -541,7 +549,9 @@ class ZKServer:
                  watchtable: bool | None = None,
                  fanout_shards: int | None = None,
                  member: str | None = None,
-                 trace: bool | None = None):
+                 trace: bool | None = None,
+                 transport: str | None = None,
+                 flush_cap: int | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -604,9 +614,24 @@ class ZKServer:
         #: Outbound write coalescing for accepted connections
         #: (io/sendplane.py): None = process default, True/False force.
         self.cork = cork
+        #: Early-flush cap for accepted connections' planes (None =
+        #: ZKSTREAM_FLUSH_CAP / the 256 KiB default).
+        self.flush_cap = flush_cap
         #: Optional utils/metrics.Collector: when set, accepted
         #: connections record their flush-batch-size histograms here.
         self.collector = collector
+        #: Batched-syscall transport tier (io/transport.py): one
+        #: submission queue shared by every accepted connection's
+        #: send plane — a corked tick's replies and fan-out flushes
+        #: leave in ONE batched syscall chain on the uring backend
+        #: (one writev per dirty conn, submitted in one C call, on
+        #: mmsg).  None when the resolved backend is 'asyncio' (the
+        #: env-gated validator: ZKSTREAM_TRANSPORT=asyncio).
+        #: ``transport=`` forces a tier like the cork/codec knobs.
+        from ..io.transport import make_tier
+        self.transport_tier = make_tier(transport, collector=collector,
+                                        plane='server',
+                                        ledger=self.ledger)
         self._server: asyncio.base_events.Server | None = None
         self.conns: set[ServerConnection] = set()
         #: Fault-injection knobs for tests: swallow pings (forces the
@@ -699,9 +724,15 @@ class ZKServer:
                 if c.session is not None and c.session.id == session_id:
                     c.close()
 
+    #: Listen backlog: the asyncio default (100) drops handshakes
+    #: under a thundering herd of reconnects at fleet scale — a
+    #: member serving 10k connections must survive 10k dials.
+    BACKLOG = 1024
+
     async def start(self) -> 'ZKServer':
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port)
+            self._on_client, self.host, self.port,
+            backlog=self.BACKLOG)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info('ZK server listening on %s:%d', self.host, self.port)
         return self
@@ -739,6 +770,13 @@ class ZKServer:
             self._server = None
         if self._owns_wal and not self.db.wal.closed:
             self.db.wal.close()
+        if self.transport_tier is not None:
+            # release the tier's io_uring fd + mmaps with the server:
+            # connection/plane/entry closures hold the tier in
+            # reference cycles, so GC-time release is unreliable at
+            # chaos-campaign churn rates.  restart() lazily
+            # re-creates the ring on the next submission.
+            self.transport_tier.close()
 
     async def restart(self, from_disk: bool = False) -> 'ZKServer':
         """Bring a killed member back on its old port; a rejoining
@@ -760,7 +798,8 @@ class ZKServer:
             self.db.wal.reopen()     # stop() closed it with the member
         self.store.catch_up()
         self._server = await asyncio.start_server(
-            self._on_client, self.host, self.port)
+            self._on_client, self.host, self.port,
+            backlog=self.BACKLOG)
         return self
 
     @property
@@ -874,6 +913,9 @@ class ZKServer:
             ('zk_fanout_shards',
              0 if self.watch_table is None
              else self.watch_table.nshards),
+            ('zk_transport_backend',
+             'asyncio' if self.transport_tier is None
+             else self.transport_tier.backend),
         ] + tick_rows + wal_rows
 
     def admin_text(self, word: str) -> str:
@@ -939,7 +981,8 @@ class ZKEnsemble:
                  watchtable: bool | None = None,
                  election: bool | None = None,
                  heartbeat_ms: int | None = None,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 transport: str | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -962,7 +1005,8 @@ class ZKEnsemble:
             ZKServer(self.db, host=host,
                      store=None if i == 0 else ReplicaStore(self.db,
                                                             lag=lag),
-                     watchtable=watchtable, member=str(i))
+                     watchtable=watchtable, member=str(i),
+                     transport=transport)
             for i in range(count)]
         #: Quorum leader election (server/election.py): on by default;
         #: ``election=False`` / ``ZKSTREAM_NO_ELECTION=1`` keeps the

@@ -45,6 +45,15 @@ Observability: per-shard flush batches land in the shared
 ``zookeeper_flush_batch_frames`` / ``_bytes`` histograms labelled
 ``plane="fanout"``; shard-flush duration in ``zk_fanout_tick_ms``.
 Both are scraped by ``bench.py --fanout`` (`make bench-fanout`).
+
+Beneath the shard cork sits the batched-syscall transport tier
+(io/transport.py): each dirty connection's ``send_flush`` defers its
+joined batch to the server's shared submission queue, so a wide
+fan-out tick leaves in ONE io_uring submission (or one C writev
+batch) covering every shard's connections instead of one
+``transport.write`` per subscriber — the ordering and durability
+contracts above are enforced by the send plane identically on every
+backend.
 """
 
 from __future__ import annotations
@@ -314,9 +323,24 @@ class WatchTable:
                         sched.append(shard)
                 buf.append(data)
         if sched:
-            loop = ambient_loop()
-            for shard in sched:
-                loop.call_soon(self._flush_shard, shard)
+            self._schedule_shards(sched)
+
+    def _schedule_shards(self, shards: list) -> None:
+        """Schedule shard flushes for the tick boundary.  With a
+        batched transport tier the flush runs inside the tier's one
+        tick callback, BEFORE its submission — so a wide fan-out's
+        bytes ride the same batched syscall chain as the tick's
+        replies instead of trailing it by a loop hop (or fragmenting
+        into per-shard submissions)."""
+        tier = getattr(self.server, 'transport_tier', None)
+        if tier is not None:
+            for shard in shards:
+                tier.schedule_call(
+                    lambda s=shard: self._flush_shard(s))
+            return
+        loop = ambient_loop()
+        for shard in shards:
+            loop.call_soon(self._flush_shard, shard)
 
     # -- notification encode (per-tick memo) --
 
@@ -362,7 +386,7 @@ class WatchTable:
             shard.dirty.append(conn)
             if not shard.scheduled:
                 shard.scheduled = True
-                ambient_loop().call_soon(self._flush_shard, shard)
+                self._schedule_shards([shard])
         buf.append(data)
 
     def _flush_shard(self, shard: _Shard) -> None:

@@ -60,6 +60,11 @@ class Counter:
     def value(self, labels: dict[str, str] | None = None) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def label_keys(self) -> list[tuple[tuple[str, str], ...]]:
+        """Every label set with a recorded value (scrape helpers walk
+        this to enumerate series, like Histogram.label_keys)."""
+        return list(self._values.keys())
+
     def expose(self) -> str:
         lines = []
         if self.help:
