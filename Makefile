@@ -6,9 +6,10 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check native bench asan chaos chaos-ensemble obs \
-    durability election bench-wal bench-fanout bench-trace \
-    bench-election bench-transport timeline coverage clean
+.PHONY: all test check analyze native bench asan ubsan sanitize \
+    chaos chaos-ensemble obs durability election bench-wal \
+    bench-fanout bench-trace bench-election bench-transport \
+    timeline coverage clean
 
 all: check test
 
@@ -122,8 +123,18 @@ timeline:
 bench-trace:
 	$(PYTHON) bench.py --traceov
 
-check:
+check: analyze
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)
+
+# Semantic static analysis (tools/zkanalyze.py -> zkstream_tpu/
+# analysis/): the contract tier above lint — loop-blocking,
+# await-under-lock, span-leak, fault-order and knob/metric drift,
+# one checker per rule the PR trail established (README "Static
+# analysis").  Zero findings on the package is the committed
+# baseline; suppressions demand a reason and are listed with
+# `python tools/zkanalyze.py --list-suppressions`.
+analyze:
+	$(PYTHON) tools/zkanalyze.py zkstream_tpu
 
 # Build the native host codecs (zkwire.cpp C-ABI scanner and the
 # zkwire_ext.c CPython-extension decoder).  Optional: the runtime
@@ -137,6 +148,15 @@ native:
 # with valid corpora + a 20k-round mutation storm (tools/asan_check.py).
 asan:
 	$(PYTHON) tools/asan_check.py
+
+# Undefined-behavior check: the same corpora + storm through a
+# -fsanitize=undefined -fno-sanitize-recover build, so shift/overflow/
+# alignment UB aborts instead of silently miscomputing.
+ubsan:
+	$(PYTHON) tools/asan_check.py --ubsan
+
+# Both sanitizer drives, back to back.
+sanitize: asan ubsan
 
 bench:
 	$(PYTHON) bench.py

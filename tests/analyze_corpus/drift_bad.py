@@ -1,0 +1,25 @@
+"""Seeded violations for the drift checker (run against
+``corpus_readme.md``): an undocumented knob, an undocumented metric,
+and one metric incremented with forked label-key sets."""
+
+import os
+
+METRIC_GOOD = 'zkstream_corpus_ticks'
+METRIC_SECRET = 'zkstream_corpus_hidden_total'
+
+
+class Plane:
+    def __init__(self, collector):
+        # VIOLATION: knob read but absent from the README inventory
+        self.turbo = os.environ.get('ZKSTREAM_CORPUS_TURBO') == '1'
+        self.ticks = collector.counter(METRIC_GOOD, 'documented')
+        # VIOLATION: registered but absent from the README table
+        self.hidden = collector.counter(METRIC_SECRET, 'undocumented')
+
+    def tick(self, plane):
+        self.ticks.increment({'plane': plane})
+
+    def tick_legacy(self, plane):
+        # VIOLATION: same metric, different label-key set — the
+        # series forks
+        self.ticks.increment({'plane': plane, 'backend': 'legacy'})

@@ -1,0 +1,18 @@
+"""Clean twin of drift_bad.py: every knob and metric appears in
+``corpus_readme.md`` and the label-key set never forks."""
+
+import os
+
+METRIC_GOOD = 'zkstream_corpus_ticks'
+
+
+class Plane:
+    def __init__(self, collector):
+        self.nocork = os.environ.get('ZKSTREAM_CORPUS_NOCORK') == '1'
+        self.ticks = collector.counter(METRIC_GOOD, 'documented')
+
+    def tick(self, plane):
+        self.ticks.increment({'plane': plane})
+
+    def tick_server(self):
+        self.ticks.increment({'plane': 'server'})

@@ -1,0 +1,17 @@
+"""Seeded violation: frame corked before the fault injector saw it —
+the ordering bug PRs 4/6/9 each had to re-derive the rule against.
+An injected mid-frame reset now targets a frame that already left in
+an earlier coalesced write, and the schedule stops reproducing."""
+
+
+class BadServerConnection:
+    def _write_bytes(self, data):
+        if self.closed:
+            return
+        # VIOLATION: the cork boundary runs first; the injector only
+        # screens the frame after it is already queued for the tick
+        # flush
+        self._tx.send(data)
+        fi = self.server.faults
+        if fi is not None:
+            fi.server_tx(self, data, pre=self._tx.flush_hard)

@@ -1,0 +1,14 @@
+"""Clean twin of faultorder_bad.py: the server/server.py
+``_write_bytes`` shape — injection screens the frame BEFORE the cork,
+with the pre-flush hook keeping stream order."""
+
+
+class GoodServerConnection:
+    def _write_bytes(self, data):
+        if self.closed:
+            return
+        fi = self.server.faults
+        if fi is not None and fi.server_tx(self, data,
+                                           pre=self._tx.flush_hard):
+            return   # the injector took over delivery
+        self._tx.send(data)

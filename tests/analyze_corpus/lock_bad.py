@@ -1,0 +1,32 @@
+"""Seeded violations: suspension and lost updates under a thread
+lock — the synthetic ``ReplicaStore``-shaped class the acceptance
+criteria name (the PR 3 ``_apply_until`` bug class re-introduced)."""
+
+import asyncio
+import threading
+
+
+class ReplicaStore:
+    """Loop/thread-shared: the applier thread and the event loop both
+    touch ``_applied`` (that is why it owns a threading lock)."""
+
+    def __init__(self):
+        self._apply_lock = threading.Lock()
+        self._applied = 0
+        self._log = []
+
+    async def apply_until(self, target):
+        # VIOLATION (await-under-lock): the applier thread contending
+        # for _apply_lock stalls until the loop resumes this coroutine
+        with self._apply_lock:
+            while self._applied < target:
+                await asyncio.sleep(0)
+
+    async def advance(self):
+        # VIOLATION (rmw across await): the read-modify-write of
+        # _applied spans a suspension — the applier thread interleaves
+        # at the await and its update is lost
+        v = self._applied
+        await asyncio.sleep(0)
+        self._applied = v + 1
+        return self._applied

@@ -1,0 +1,35 @@
+"""Clean twin of lock_bad.py: same class shape, contract honored —
+the lock body never suspends and the shared counter is recomputed
+after the await."""
+
+import asyncio
+import threading
+
+
+class ReplicaStore:
+    def __init__(self):
+        self._apply_lock = threading.Lock()
+        self._applied = 0
+        self._log = []
+
+    def apply_one(self, entry):
+        # fine: sync critical section, no suspension point inside
+        with self._apply_lock:
+            self._log.append(entry)
+            self._applied += 1
+
+    async def apply_until(self, target):
+        # fine: poll outside the lock, take it only for the sync step
+        while True:
+            with self._apply_lock:
+                done = self._applied >= target
+            if done:
+                return
+            await asyncio.sleep(0)
+
+    async def advance(self):
+        # fine: the read-modify-write is entirely after the await
+        await asyncio.sleep(0)
+        with self._apply_lock:
+            self._applied += 1
+            return self._applied

@@ -1,0 +1,29 @@
+"""Seeded violations: blocking calls reaching the event loop.
+
+The PR 5 contract inverted — fsync/sleep/subprocess on the loop.
+Every pattern here must be caught by the ``loop-blocking`` checker;
+tests/test_analyze.py pins the exact count.
+"""
+
+import os
+import subprocess
+import time
+
+
+class BadWal:
+    async def group_sync(self, fd):
+        # VIOLATION: fsync directly in a coroutine — the loop stalls
+        # for the device's whole ack latency
+        os.fsync(fd)
+
+    async def settle(self, delay):
+        # VIOLATION: parks every session the loop serves
+        time.sleep(delay)
+
+    def _tick_flush(self):
+        # VIOLATION: this sync function is loop-registered (below),
+        # so the child wait runs on the loop
+        subprocess.run(['true'])
+
+    def arm(self, loop):
+        loop.call_soon(self._tick_flush)

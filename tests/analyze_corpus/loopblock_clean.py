@@ -1,0 +1,26 @@
+"""Clean twin of loopblock_bad.py: the same blocking work, but
+executor-wrapped (or annotated) per the contract — zero findings."""
+
+import asyncio
+import os
+import time
+
+
+class GoodWal:
+    async def group_sync(self, fd):
+        loop = asyncio.get_running_loop()
+
+        def work():
+            # fine: nearest enclosing function is the executor thunk
+            os.fsync(fd)
+
+        await loop.run_in_executor(None, work)
+
+    async def settle(self, delay):
+        await asyncio.sleep(delay)
+
+    def sync_now(self, fd, delay):
+        # fine: plain sync function, never handed to the loop — the
+        # documented blocking barrier (fsync_gate) pattern
+        time.sleep(delay)
+        os.fsync(fd)

@@ -1,0 +1,40 @@
+"""Seeded violations: the PR 7 abandoned-span class re-introduced.
+
+``_start_op_leaky`` is client.py's ``_start_op`` with the
+settle-on-raise guard removed — the exact shape that shipped the
+span leak; ``await_leak`` is its coroutine form (open span across a
+raising await); ``early_return`` and ``dropped`` are the structural
+variants."""
+
+
+class LeakyClient:
+    def __init__(self, trace):
+        self.trace = trace
+
+    def _start_op_leaky(self, conn, pkt):
+        span = self.trace.start(pkt['opcode'], pkt.get('path'))
+        # VIOLATION: conn.request can raise; nothing settles the span
+        # on that edge (the removed try/except was the fix)
+        req = conn.request(pkt)
+        span.xid = pkt['xid']
+        req.span = span
+        return req.as_future(), span
+
+    async def await_leak(self, fut):
+        span = self.trace.start('GET', '/p')
+        # VIOLATION: if the await raises, the span stays open forever
+        res = await fut
+        span.finish(zxid=res)
+        return res
+
+    def early_return(self, conn):
+        span = self.trace.start('PING')
+        if conn is None:
+            # VIOLATION: this path returns with the span open
+            return None
+        span.finish()
+        return span.duration_ms
+
+    def dropped(self):
+        # VIOLATION: started and dropped — nothing can ever settle it
+        self.trace.start('EXISTS', '/x')

@@ -1,0 +1,42 @@
+"""Clean twin of span_bad.py: every span settles or escapes on all
+paths — the client.py ``_start_op`` / ``ping`` idioms."""
+
+
+class SettlingClient:
+    def __init__(self, trace):
+        self.trace = trace
+        self.on_op = None
+
+    def _start_op(self, conn, pkt):
+        span = self.trace.start(pkt['opcode'], pkt.get('path'))
+        try:
+            req = conn.request(pkt)
+        except BaseException:
+            # the request never entered the pending table: settle
+            # before the error propagates (the PR 7 fix)
+            span.finish(status='abandoned')
+            raise
+        span.xid = pkt['xid']
+        req.span = span          # escape: the connection settles it
+        return req.as_future(), span
+
+    async def awaited(self, fut):
+        span = self.trace.start('GET', '/p')
+        try:
+            res = await fut
+        finally:
+            span.finish()
+        return res
+
+    def branchy(self, conn):
+        span = self.trace.start('PING')
+        if conn is None:
+            span.finish(status='error')
+            return None
+        span.finish()
+        return span.duration_ms
+
+    def handed_off(self, pool):
+        span = self.trace.start('SYNC', '/')
+        pool.track(span)         # escape: ownership transferred
+        return span

@@ -1,0 +1,14 @@
+"""Negative suppression fixture: annotations with NO reason string —
+each is itself a finding (the gate demands the why)."""
+
+import os
+
+
+class LazyWal:
+    async def group_sync(self, fd):
+        # zkanalyze: off-loop
+        os.fsync(fd)
+
+    async def sync_again(self, fd):
+        # zkanalyze: ignore[loop-blocking]
+        os.fsync(fd)

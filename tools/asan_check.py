@@ -1,15 +1,21 @@
-"""Memory-safety check for the C-extension decoder (zkwire_ext.c).
+"""Sanitizer checks for the C-extension decoder (zkwire_ext.c).
 
-Builds the extension with AddressSanitizer and drives both decode
+Builds the extension under a sanitizer and drives both decode
 directions with valid corpora plus a mutation storm (random
-truncations/bit flips/suffixes of valid wire), so every bounds check in
-the C code gets adversarial coverage.  Any out-of-bounds access aborts
-the process with an ASAN report.
+truncations/bit flips/suffixes of valid wire), so every bounds check
+in the C code gets adversarial coverage:
 
-Must run as a child process with libasan preloaded; this script
-re-execs itself with LD_PRELOAD when needed.
+- default (``make asan``): AddressSanitizer — any out-of-bounds
+  access aborts the process with an ASAN report;
+- ``--ubsan`` (``make ubsan``): UndefinedBehaviorSanitizer with
+  ``-fno-sanitize-recover=undefined`` — shift/overflow/alignment/
+  null-deref UB aborts instead of silently miscomputing;
+- ``make sanitize`` runs both.
 
-Usage:  python tools/asan_check.py  (or `make asan`)
+Must run as a child process with the sanitizer runtime preloaded;
+this script re-execs itself with LD_PRELOAD when needed.
+
+Usage:  python tools/asan_check.py [--ubsan]
 """
 
 from __future__ import annotations
@@ -19,42 +25,70 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SO = '/tmp/_zkwire_ext_asan.so'
 ROUNDS = int(os.environ.get('ASAN_ROUNDS', '20000'))
 
+#: Per-mode build recipe: compile flags, runtime library to preload,
+#: runtime options env var.
+MODES = {
+    'asan': {
+        'so': '/tmp/_zkwire_ext_asan.so',
+        'cflags': ['-fsanitize=address'],
+        'runtime': 'libasan.so',
+        'env': ('ASAN_OPTIONS', 'detect_leaks=0:abort_on_error=1'),
+    },
+    'ubsan': {
+        'so': '/tmp/_zkwire_ext_ubsan.so',
+        'cflags': ['-fsanitize=undefined',
+                   '-fno-sanitize-recover=undefined'],
+        'runtime': 'libubsan.so',
+        'env': ('UBSAN_OPTIONS', 'print_stacktrace=1:halt_on_error=1'),
+    },
+}
 
-def build() -> str | None:
+
+def build(mode: str) -> str | None:
     import sysconfig
+    spec = MODES[mode]
     src = os.path.join(REPO, 'native', 'zkwire_ext.c')
-    cmd = ['gcc', '-O1', '-g', '-fsanitize=address', '-shared', '-fPIC',
-           '-I', sysconfig.get_paths()['include'], src, '-o', SO]
+    cmd = (['gcc', '-O1', '-g'] + spec['cflags']
+           + ['-shared', '-fPIC',
+              '-I', sysconfig.get_paths()['include'], src,
+              '-o', spec['so']])
     r = subprocess.run(cmd, capture_output=True, text=True)
     if r.returncode != 0:
         print('build failed:\n%s' % r.stderr, file=sys.stderr)
         return None
-    r = subprocess.run(['gcc', '-print-file-name=libasan.so'],
+    r = subprocess.run(['gcc', '-print-file-name=%s'
+                        % spec['runtime']],
                        capture_output=True, text=True)
     return r.stdout.strip()
 
 
 def main() -> int:
-    if os.environ.get('_ASAN_CHILD') != '1':
-        libasan = build()
-        if not libasan or not os.path.exists(libasan):
-            print('asan unavailable; skipping', file=sys.stderr)
+    mode = 'ubsan' if '--ubsan' in sys.argv[1:] else 'asan'
+    if os.environ.get('_SAN_CHILD') != '1':
+        runtime = build(mode)
+        if not runtime or not os.path.exists(runtime):
+            print('%s unavailable; skipping' % (mode,),
+                  file=sys.stderr)
             return 0
-        env = dict(os.environ, _ASAN_CHILD='1', LD_PRELOAD=libasan,
-                   ASAN_OPTIONS='detect_leaks=0:abort_on_error=1')
-        return subprocess.run([sys.executable, __file__],
-                              env=env).returncode
+        opt_var, opt_val = MODES[mode]['env']
+        env = dict(os.environ, _SAN_CHILD='1', _SAN_MODE=mode,
+                   LD_PRELOAD=runtime, **{opt_var: opt_val})
+        return subprocess.run([sys.executable, __file__]
+                              + sys.argv[1:], env=env).returncode
+
+    mode = os.environ.get('_SAN_MODE', mode)
+    so = MODES[mode]['so']
 
     import importlib.machinery
     import importlib.util
     import random
 
-    loader = importlib.machinery.ExtensionFileLoader('_zkwire_ext', SO)
+    loader = importlib.machinery.ExtensionFileLoader('_zkwire_ext',
+                                                     so)
     spec = importlib.util.spec_from_file_location(
-        '_zkwire_ext', SO, loader=loader)
+        '_zkwire_ext', so, loader=loader)
     mod = importlib.util.module_from_spec(spec)
     loader.exec_module(mod)
 
@@ -137,8 +171,8 @@ def main() -> int:
                                  'stat': records.Stat()})
         except Exception:
             pass
-    print('mutation storm (%d rounds x 2 calls): no ASAN reports'
-          % ROUNDS)
+    print('mutation storm (%d rounds x 2 calls): no %s reports'
+          % (ROUNDS, mode.upper()))
     return 0
 
 

