@@ -9,7 +9,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 .PHONY: all test check analyze native bench asan ubsan sanitize \
     chaos chaos-ensemble obs durability election bench-wal \
     bench-fanout bench-trace bench-election bench-transport \
-    timeline coverage clean
+    bench-quorum timeline coverage clean
 
 all: check test
 
@@ -37,13 +37,21 @@ chaos-ensemble:
 
 # Durability plane (server/persist.py; README "Durability"): the WAL
 # unit corpus (torn-write truncation at every byte offset, bit-flip
-# CRC rejection, rotation/snapshot recovery, sync policies) plus the
+# CRC rejection, rotation/snapshot recovery, sync policies), the
 # ensemble tier-1 slice — whose every schedule now ends with a
 # full-ensemble SIGKILL crash image and a restart-from-disk recovery
-# checked by the invariant engine (invariant 6, io/invariants.py).
+# checked by the invariant engine (invariant 6, io/invariants.py) —
+# plus the PR-12 scenario suite: torn-multi all-or-nothing recovery
+# at every byte offset, full-restart-with-live-ephemerals (durable
+# sessions), the quorum-gate units, and the MULTI pillar; the
+# leader-killed-after-ack scenario runs on the OS-process tier
+# (tests/test_process_ensemble.py / chaos --tier process).
 durability:
 	$(PYTHON) -m pytest tests/test_wal.py tests/test_chaos_ensemble.py \
+	    tests/test_durability_scenarios.py tests/test_multi.py \
 	    -q -m 'not slow'
+	$(PYTHON) -m pytest tests/test_process_ensemble.py -q \
+	    -k 'election_kill_loop'
 
 # Coordination plane (server/election.py; README "Failure
 # semantics"): the vote rule + invariant 7 units, the in-process
@@ -67,6 +75,15 @@ election:
 # between the sizes.  Rounds via ZKSTREAM_BENCH_ELECTION_ROUNDS.
 bench-election:
 	$(PYTHON) bench.py --election
+
+# Quorum-commit cost envelope: paired quorum-on/off write-heavy
+# cells at 3/5 in-process members (the leader's ack gated on the
+# majority floor vs the fsync-only barrier) plus MULTI batching
+# cells (one multi of K creates vs K pipelined singletons), exact
+# sign tests (table in PROFILE.md "Quorum commit").  Rounds via
+# ZKSTREAM_BENCH_QUORUM_ROUNDS.
+bench-quorum:
+	$(PYTHON) bench.py --quorum
 
 # Paired durability-cost envelope: wal-off vs sync=tick (group
 # commit) vs sync=always write-heavy cells at fleet 16/64 with

@@ -1233,6 +1233,189 @@ def bench_election() -> None:
         }), flush=True)
 
 
+#: `bench.py --quorum` ensemble sizes (the acceptance envelope:
+#: quorum-on must not be significantly slower than quorum-off at
+#: either membership — with synchronous in-process replicas the gate
+#: clears at flush time and its cost is bookkeeping).
+QUORUM_SCALES = (3, 5)
+#: MULTI batching cells: one multi of K creates vs K pipelined
+#: singleton creates (same client, same server, adjacent runs).
+MULTI_BATCHES = (4, 16)
+QUORUM_OPS = 200
+
+
+async def _quorum_round(members: int, quorum_on: bool) -> dict:
+    """One write-heavy cell against a fresh in-process ensemble with
+    the quorum gate on or off: sequential acked sets through the
+    leader, headline set ops/s plus the zk_quorum_ack_ms scrape."""
+    import asyncio as aio
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.server import ZKEnsemble
+    from zkstream_tpu.server.replication import METRIC_QUORUM_ACK
+    from zkstream_tpu.utils.metrics import Collector
+
+    collector = Collector()
+    ens = await ZKEnsemble(members, quorum=quorum_on,
+                           collector=collector).start()
+    c = Client(servers=ens.addresses(), shuffle_backends=False,
+               session_timeout=8000)
+    c.start()
+    loop = aio.get_running_loop()
+    try:
+        await c.wait_connected(timeout=10)
+        await c.create('/q', b'w')
+        for _ in range(10):
+            await c.set('/q', b'warm', version=-1)
+        t0 = loop.time()
+        for i in range(QUORUM_OPS):
+            await c.set('/q', b'v%d' % (i,), version=-1)
+        dt = loop.time() - t0
+        out = {'members': members,
+               'quorum': 'on' if quorum_on else 'off',
+               'set': {'ops_per_sec': round(QUORUM_OPS / dt, 1)}}
+        if quorum_on:
+            hist = collector.get_collector(METRIC_QUORUM_ACK)
+            n = hist.count()
+            if n:
+                out['quorum_ack'] = {
+                    'count': n,
+                    'p50_ms': round(hist.percentile(50), 3),
+                    'p99_ms': round(hist.percentile(99), 3)}
+            out['quorum_degraded'] = ens.quorum.degraded_releases
+        return out
+    finally:
+        await c.close()
+        await ens.stop()
+
+
+async def _multi_round(k: int) -> dict:
+    """One batching cell: K pipelined singleton creates vs ONE multi
+    of K creates, adjacent on the same client/server — sub-op
+    throughput both ways."""
+    import asyncio as aio
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.server import ZKServer
+
+    srv = await ZKServer().start()
+    c = Client(address='127.0.0.1', port=srv.port)
+    c.start()
+    loop = aio.get_running_loop()
+    try:
+        await c.wait_connected(timeout=10)
+        await c.create('/warm', b'')
+        reps = max(1, 64 // k)
+        t0 = loop.time()
+        for r in range(reps):
+            await aio.gather(*[
+                c.create('/s%d-%d' % (r, i), b'x')
+                for i in range(k)])
+        dt_single = loop.time() - t0
+        t0 = loop.time()
+        for r in range(reps):
+            await c.multi([
+                {'op': 'create', 'path': '/m%d-%d' % (r, i),
+                 'data': b'x'}
+                for i in range(k)])
+        dt_multi = loop.time() - t0
+        n = reps * k
+        return {'batch': k,
+                'singleton_subops_per_sec': round(n / dt_single, 1),
+                'multi_subops_per_sec': round(n / dt_multi, 1)}
+    finally:
+        await c.close()
+        await srv.stop()
+
+
+def bench_quorum() -> None:
+    """The quorum-commit cost envelope (`make bench-quorum`): paired
+    quorum-on/off write-heavy cells at 3/5 members, plus
+    MULTI-vs-N-singletons batching cells — per-round adjacent runs,
+    exact two-sided sign tests (the acceptance bar: neither quorum-on
+    nor MULTI significantly slower in any paired cell).  Rounds via
+    ZKSTREAM_BENCH_QUORUM_ROUNDS; the measured table lives in
+    PROFILE.md "Quorum commit"."""
+    import asyncio
+
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_QUORUM_ROUNDS', '10'))
+    rows: dict = {}
+    cells: dict = {}
+    mrows: dict = {k: [] for k in MULTI_BATCHES}
+    for _rnd in range(rounds):
+        for n in QUORUM_SCALES:
+            for q_on in (True, False):
+                try:
+                    r = asyncio.run(_quorum_round(n, q_on))
+                except Exception as e:
+                    print('# quorum cell %s@%d round failed: %r'
+                          % ('on' if q_on else 'off', n, e),
+                          file=sys.stderr)
+                    continue
+                key = (n, 'on' if q_on else 'off')
+                rows.setdefault(key, []).append(
+                    r['set']['ops_per_sec'])
+                if key not in cells or r['set']['ops_per_sec'] > \
+                        cells[key]['set']['ops_per_sec']:
+                    cells[key] = r
+        for k in MULTI_BATCHES:
+            try:
+                r = asyncio.run(_multi_round(k))
+            except Exception as e:
+                print('# multi cell batch=%d round failed: %r'
+                      % (k, e), file=sys.stderr)
+                continue
+            mrows[k].append((r['multi_subops_per_sec'],
+                             r['singleton_subops_per_sec']))
+            mkey = ('multi', k)
+            if mkey not in cells or r['multi_subops_per_sec'] > \
+                    cells[mkey]['multi_subops_per_sec']:
+                cells[mkey] = r
+    for key in sorted(cells, key=str):
+        print('# quorum_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for n in QUORUM_SCALES:
+        a = rows.get((n, 'on'), [])
+        b = rows.get((n, 'off'), [])
+        if not a or not b:
+            continue
+        paired = list(zip(a, b))
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired if x > y)
+        losses = sum(1 for x, y in paired if x < y)
+        print(json.dumps({
+            'metric': 'quorum_commit_sign_test',
+            'pair': 'on-vs-off',
+            'members': n,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+    for k in MULTI_BATCHES:
+        paired = mrows[k]
+        if not paired:
+            continue
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired if x > y)
+        losses = sum(1 for x, y in paired if x < y)
+        print(json.dumps({
+            'metric': 'multi_batching_sign_test',
+            'pair': 'multi-vs-%d-singletons' % (k,),
+            'batch': k,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --traceov` fleet sizes (the acceptance envelope: the
 #: server trace plane — member span rings + tick ledger — must not be
 #: significantly slower than the untraced arm at either scale).
@@ -2012,6 +2195,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_election()
+        return
+    if '--quorum' in sys.argv:
+        # `make bench-quorum`: the quorum-commit cost family
+        # (quorum-on/off at 3/5 members + MULTI batching cells).
+        # Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_quorum()
         return
     if '--traceov' in sys.argv:
         # `make bench-trace`: the paired trace-plane overhead family

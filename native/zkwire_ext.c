@@ -110,6 +110,9 @@ typedef struct {
   Py_ssize_t len;
   Py_ssize_t off;
   char err[192]; /* non-empty => decode error */
+  int unsupported; /* protocol-valid opcode this tier has no layout
+                    * for (e.g. MULTI): the frame is left in the
+                    * buffer and the Python spec tier decodes it */
 } Cursor;
 
 static int need(Cursor *c, Py_ssize_t n) {
@@ -390,6 +393,20 @@ static PyObject *decode_reply(Cursor *c, PyObject *xid_map) {
       }
       Py_INCREF(opcode);
       opcode_owned = 1;
+      /* punt BEFORE consuming the xid: a reply opcode this tier has
+       * no body layout for (MULTI) goes back to the Python spec,
+       * which pops the xid itself.  Error replies carry no body, so
+       * they stay decodable here whatever the opcode. */
+      if (errc == 0) {
+        PyObject *layout = PyDict_GetItemWithError(g_layouts, opcode);
+        if (layout == NULL) {
+          Py_DECREF(k);
+          if (PyErr_Occurred()) goto fail;
+          snprintf(c->err, sizeof(c->err), "unsupported reply opcode");
+          c->unsupported = 1;
+          goto fail;
+        }
+      }
       if (PyDict_DelItem(xid_map, k) < 0) {
         Py_DECREF(k);
         goto fail;
@@ -518,13 +535,18 @@ static PyObject *decode_request(Cursor *c) {
   PyObject *entry = int_key_get(g_req_opcodes, op);
   if (entry == NULL) {
     /* match the Python spec's two distinct failures: a protocol-valid
-     * opcode with no request reader vs a number outside the enum */
+     * opcode with no request reader vs a number outside the enum.  A
+     * valid opcode is a PUNT, not an error: the spec tier may carry a
+     * reader this tier does not (MULTI) — the driver leaves the frame
+     * in the buffer and the Python path decides. */
     PyObject *known = int_key_get(g_op_names, op);
-    if (known != NULL)
+    if (known != NULL) {
       snprintf(c->err, sizeof(c->err), "unsupported opcode '%s'",
                PyUnicode_AsUTF8(known));
-    else
+      c->unsupported = 1;
+    } else {
       snprintf(c->err, sizeof(c->err), "%d is not a valid OpCode", op);
+    }
     return NULL;
   }
   PyObject *name = PyTuple_GET_ITEM(entry, 0);   /* borrowed */
@@ -1046,6 +1068,15 @@ static PyObject *decode_stream(Py_buffer view, PyObject *xid_map,
         PyBuffer_Release(&view);
         return NULL;
       }
+      if (c.unsupported) {
+        /* valid frame, no layout in this tier: leave it (and
+         * everything after it) in the buffer for the Python spec
+         * tier — consumed stops at the frame boundary */
+        err_kind = "UNSUPPORTED";
+        snprintf(err_msg, sizeof(err_msg), "%s", c.err);
+        consumed = off;
+        goto done;
+      }
       err_kind = "BAD_DECODE";
       snprintf(err_msg, sizeof(err_msg), "Failed to decode %s: %s",
                what, c.err);
@@ -1089,7 +1120,7 @@ static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
 }
 
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(6);
+  return PyLong_FromLong(7);
 }
 
 /* CRC32C (Castagnoli, reflected 0x82F63B78) for the write-ahead-log

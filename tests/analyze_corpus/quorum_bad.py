@@ -1,0 +1,13 @@
+"""Seeded violation: the reply bytes reach the transport BEFORE the
+ack barrier — the exact gap quorum-commit (PR 12) closes.  The client
+sees an ack whose txn is neither fsynced nor majority-held: a leader
+death can still un-happen it."""
+
+
+class BadAckPath:
+    def _finish_write(self, reply):
+        # VIOLATION: raw write first, barrier after — the ack left
+        # before the group fsync or the quorum gate could hold it
+        self.writer.write(reply)
+        self._barrier.sync_for_flush()
+        self.quorum.gate_flush(self._release)

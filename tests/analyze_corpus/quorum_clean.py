@@ -1,0 +1,12 @@
+"""Clean twin of quorum_bad.py: both halves of the ack barrier clear
+before any byte reaches the transport (the io/sendplane.py barrier
+contract; server/replication.py CommitBarrier)."""
+
+
+class GoodAckPath:
+    def _finish_write(self, reply):
+        self._barrier.sync_for_flush()
+        if not self.quorum.gate_flush(self._release):
+            self._parked.append(reply)
+            return
+        self.writer.write(reply)

@@ -104,6 +104,18 @@ def test_fault_order_clean_twin_passes():
     assert run_corpus('faultorder_clean.py').findings == []
 
 
+def test_ack_order_catches_write_before_barrier():
+    report = run_corpus('quorum_bad.py')
+    assert checkers_hit(report) == {'ack-order': 1}
+    (f,) = report.findings
+    assert 'precedes the ack barrier' in f.message
+    assert 'quorum gate' in f.message
+
+
+def test_ack_order_clean_twin_passes():
+    assert run_corpus('quorum_clean.py').findings == []
+
+
 def test_drift_catches_knob_metric_and_label_fork():
     report = run_corpus('drift_bad.py')
     assert checkers_hit(report) == {'drift': 3}

@@ -307,19 +307,26 @@ def test_server_direction_all_opcodes_equivalent():
 
 
 def test_layout_tables_stay_in_sync_with_spec():
-    """The C decoder's opcode->layout tables must cover exactly what
-    the Python spec decodes — a reader added to records.py without a
-    layout entry would make the C path reject what the spec accepts."""
+    """The C decoder's opcode->layout tables plus its declared punt
+    set must cover exactly what the Python spec decodes — a reader
+    added to records.py without a layout entry (or an explicit punt)
+    would make the C path reject what the spec accepts."""
     from zkstream_tpu.protocol.records import (
         _EMPTY_RESPONSES,
         _REQ_READERS,
         _RESP_READERS,
     )
-    from zkstream_tpu.utils.native import _EXT_LAYOUTS, _EXT_REQ_LAYOUTS
+    from zkstream_tpu.utils.native import (
+        _EXT_LAYOUTS,
+        _EXT_PUNT_OPS,
+        _EXT_REQ_LAYOUTS,
+    )
 
-    assert set(_EXT_REQ_LAYOUTS) == set(_REQ_READERS)
-    assert set(_EXT_LAYOUTS) == \
+    assert set(_EXT_REQ_LAYOUTS) | _EXT_PUNT_OPS == set(_REQ_READERS)
+    assert set(_EXT_LAYOUTS) | _EXT_PUNT_OPS == \
         set(_RESP_READERS) | set(_EMPTY_RESPONSES)
+    assert not _EXT_PUNT_OPS & set(_EXT_REQ_LAYOUTS)
+    assert not _EXT_PUNT_OPS & set(_EXT_LAYOUTS)
 
 
 def test_unsupported_vs_invalid_opcode_messages():

@@ -144,23 +144,43 @@ async def test_sequential_counter_restored_after_recovery(tmp_path):
     db2.wal.close()
 
 
-async def test_recovery_reaps_orphan_ephemerals(tmp_path):
-    """Sessions die with the process; their recovered ephemerals are
-    reaped by logged deletes — durable, so a second crash cannot
-    resurrect them."""
+async def test_recovery_honors_session_liveness(tmp_path):
+    """Durable sessions: a session live at the crash is recovered
+    with its ephemerals intact (restart inside the session timeout —
+    the client can resume); only a DEAD session's ephemerals are
+    reaped, by logged deletes, so a second crash cannot resurrect
+    them."""
     d = str(tmp_path / 'wal')
     db = open_wal_database(d, sync='always')
-    sess = db.create_session(30000)
-    db.create('/eph', b'x', None, CreateFlag.EPHEMERAL, sess)
+    live = db.create_session(30000)
+    dead = db.create_session(30000)
+    db.create('/eph-live', b'x', None, CreateFlag.EPHEMERAL, live)
+    db.create('/eph-dead', b'x', None, CreateFlag.EPHEMERAL, dead)
     db.create('/keep', b'y', None, 0, None)
+    db.close_session(dead.id)            # reaps /eph-dead, logged
     db.wal.close()
     db2 = open_wal_database(d, sync='always')
-    assert '/eph' not in db2.nodes
+    # the live session survived with its ephemeral; a resume with the
+    # recovered credentials succeeds
+    assert '/eph-live' in db2.nodes
+    assert db2.nodes['/eph-live'].ephemeral_owner == live.id
+    assert db2.resume_session(live.id, live.passwd) is not None
+    assert db2.sessions[live.id].ephemerals == {'/eph-live'}
+    assert '/eph-dead' not in db2.nodes
     assert db2.nodes['/keep'].data == b'y'
+    # an ephemeral whose owner died WITHOUT a close record (e.g. the
+    # session record itself predates a session-table wipe) is still
+    # reaped: model it by expiring the live session, then crashing
+    db2.expire_session(live.id)
     db2.wal.close()
-    # the reap was logged: a third recovery agrees without reaping
+    db3 = open_wal_database(d, sync='always')
+    assert '/eph-live' not in db3.nodes
+    assert db3.resume_session(live.id, live.passwd) is None
+    db3.wal.close()
+    # the reaps were logged: a further recovery agrees without reaping
     rec = recover_state(d)
-    assert '/eph' not in rec.nodes
+    assert '/eph-live' not in rec.nodes and '/eph-dead' not in rec.nodes
+    assert live.id not in rec.sessions and dead.id not in rec.sessions
 
 
 # -- torn-write corpus --------------------------------------------------

@@ -176,6 +176,39 @@ REQUEST_FIXTURES = [
         b'\xff\xff\xff\xf5',              # opcode CLOSE_SESSION = -11
         {'xid': 14, 'opcode': 'CLOSE_SESSION'},
     ),
+    (
+        'MULTI',
+        # jute MultiHeader framing (upstream MultiTransactionRecord):
+        # each sub-op as int type | bool done | int err(-1) + body,
+        # terminated by type=-1, done=1, err=-1
+        b'\x00\x00\x00\x10'               # xid = 16
+        b'\x00\x00\x00\x0e'               # opcode MULTI = 14
+        b'\x00\x00\x00\x01\x00\xff\xff\xff\xff'   # hdr: CREATE
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00\x00\x00\x02hi'             # data
+        + ACL_WORLD_ALL +
+        b'\x00\x00\x00\x00'               # flags = 0
+        b'\x00\x00\x00\x0d\x00\xff\xff\xff\xff'   # hdr: CHECK
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00\x00\x00\x02'               # version = 2
+        b'\x00\x00\x00\x05\x00\xff\xff\xff\xff'   # hdr: SET_DATA
+        b'\x00\x00\x00\x02/a'             # path
+        b'\xff\xff\xff\xff'               # empty data -> length -1
+        b'\xff\xff\xff\xff'               # version = -1
+        b'\x00\x00\x00\x02\x00\xff\xff\xff\xff'   # hdr: DELETE
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00\x00\x00\x00'               # version = 0
+        b'\xff\xff\xff\xff\x01\xff\xff\xff\xff',  # terminator
+        {'xid': 16, 'opcode': 'MULTI', 'ops': [
+            {'op': 'create', 'path': '/a', 'data': b'hi',
+             'acl': [ACL(Perm.ALL, Id('world', 'anyone'))],
+             'flags': CreateFlag(0)},
+            {'op': 'check', 'path': '/a', 'version': 2},
+            {'op': 'set_data', 'path': '/a', 'data': b'',
+             'version': -1},
+            {'op': 'delete', 'path': '/a', 'version': 0},
+        ]},
+    ),
 ]
 
 # --- response fixtures (server -> client) ---
@@ -366,6 +399,32 @@ RESPONSE_FIXTURES = [
         b'\xff\xff\xff\x8d',                  # err = AUTH_FAILED
         {'xid': -4, 'zxid': 28, 'err': 'AUTH_FAILED',
          'opcode': 'AUTH'},
+    ),
+    (
+        'MULTI',
+        {16: 'MULTI'},
+        # OK results carry the op type and err=0; an ErrorResult is
+        # type=-1 with the code in the header AND as an int body
+        b'\x00\x00\x00\x10'                   # xid = 16
+        b'\x00\x00\x00\x00\x00\x00\x00\x20'   # zxid = 32
+        b'\x00\x00\x00\x00'                   # err = OK
+        b'\x00\x00\x00\x01\x00\x00\x00\x00\x00'   # hdr: CREATE ok
+        b'\x00\x00\x00\x02/a'                 # created path
+        b'\x00\x00\x00\x05\x00\x00\x00\x00\x00'   # hdr: SET_DATA ok
+        + STAT_BYTES +
+        b'\x00\x00\x00\x02\x00\x00\x00\x00\x00'   # hdr: DELETE ok
+        b'\x00\x00\x00\x0d\x00\x00\x00\x00\x00'   # hdr: CHECK ok
+        b'\xff\xff\xff\xff\x00\xff\xff\xff\x9b'   # hdr: error -101
+        b'\xff\xff\xff\x9b'                   # ErrorResult body
+        b'\xff\xff\xff\xff\x01\xff\xff\xff\xff',  # terminator
+        {'xid': 16, 'zxid': 32, 'err': 'OK', 'opcode': 'MULTI',
+         'results': [
+             {'op': 'create', 'path': '/a'},
+             {'op': 'set_data', 'stat': STAT},
+             {'op': 'delete'},
+             {'op': 'check'},
+             {'op': 'error', 'err': 'NO_NODE'},
+         ]},
     ),
 ]
 

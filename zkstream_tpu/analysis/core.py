@@ -41,7 +41,8 @@ ANALYZE_SCHEMA = 1
 #: 'parse' marks unreadable/unparseable files; neither is a valid
 #: annotation target.
 CHECKER_NAMES = ('loop-blocking', 'await-under-lock', 'span-leak',
-                 'fault-order', 'drift', 'suppression', 'parse')
+                 'fault-order', 'ack-order', 'drift', 'suppression',
+                 'parse')
 _UNSUPPRESSIBLE = ('suppression', 'parse')
 
 _SUPPRESS_RE = re.compile(
@@ -267,11 +268,12 @@ class Report:
 def _checkers():
     # imported here, not at module top: the checker modules import
     # this one for Finding/Module
-    from . import drift, faultorder, locks, loopblock, spans
+    from . import ackorder, drift, faultorder, locks, loopblock, spans
     return ((loopblock.NAME, loopblock.check),
             (locks.NAME, locks.check),
             (spans.NAME, spans.check),
             (faultorder.NAME, faultorder.check),
+            (ackorder.NAME, ackorder.check),
             (drift.NAME, drift.check))
 
 

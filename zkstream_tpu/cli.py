@@ -363,9 +363,12 @@ def build_parser() -> argparse.ArgumentParser:
                          'migration with the history-checked '
                          'invariant engine (io/invariants.py); '
                          'process: OS-process peer members — seeded '
-                         'elected-leader kill loops plus full-'
-                         'ensemble SIGKILL -> election from '
-                         'recovered WALs (server/election.py)')
+                         'elected-leader kill loops (each leader '
+                         'SIGKILLed immediately after acking a '
+                         'quorum-committed write, which must survive '
+                         'the election) plus full-ensemble SIGKILL '
+                         '-> election from recovered WALs '
+                         '(server/election.py)')
     ch.add_argument('--seed', type=int, default=0,
                     help='base seed; schedule i uses seed+i (default 0)')
     ch.add_argument('--schedules', type=int, default=20,
@@ -710,10 +713,24 @@ def _wal(args) -> int:
             for idx, entry in seg.records:
                 extra = ('' if entry[0] != 'create'
                          else ' data=%dB' % (len(entry[2]),))
-                # epoch control records carry the new epoch, not a
-                # path (server/election.py's fencing token)
-                what = ('epoch=%d' % (entry[1],)
-                        if entry[0] == 'epoch' else entry[1])
+                # control records carry no path: epoch bumps hold the
+                # fencing token (server/election.py), session records
+                # the durable session edge (server/persist.py), and a
+                # multi renders its whole all-or-nothing batch
+                if entry[0] == 'epoch':
+                    what = 'epoch=%d' % (entry[1],)
+                elif entry[0] == 'session':
+                    what = ('sid=%016x timeout=%dms'
+                            % (entry[1], entry[3]))
+                elif entry[0] == 'session_close':
+                    what = 'sid=%016x (%s)' % (entry[1], entry[3])
+                elif entry[0] == 'multi':
+                    what = '%d sub-op(s): %s' % (
+                        len(entry[1]),
+                        ', '.join('%s %s' % (s[0], s[1])
+                                  for s in entry[1]))
+                else:
+                    what = entry[1]
                 print('    #%-6d zxid=%-6d %-8s %s%s'
                       % (idx, entry_zxid(entry), entry[0], what,
                          extra))

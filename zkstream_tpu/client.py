@@ -643,6 +643,71 @@ class Client(FSM):
                                           'path': path})
         await self._await_op(fut, 'SYNC', path, deadline, span)
 
+    async def multi(self, ops: list, deadline=_USE_DEFAULT) -> list:
+        """One all-or-nothing MULTI transaction (opcode 14): ``ops``
+        is a list of sub-op dicts — ``{'op': 'create', 'path', 'data',
+        'acl'?, 'flags'?}``, ``{'op': 'delete', 'path', 'version'?}``,
+        ``{'op': 'set_data', 'path', 'data', 'version'?}``,
+        ``{'op': 'check', 'path', 'version'}`` — applied as ONE server
+        transaction sharing one WAL record and one group-fsync slot
+        (server/store.py ``ZKDatabase.multi``).  Resolves to the
+        per-op results in order (created path / new Stat / None);
+        raises :class:`~.protocol.errors.ZKMultiError` when the batch
+        was rejected — then NO sub-op was applied.
+
+        :meth:`transaction` is the builder-style sugar over this."""
+        from .protocol.errors import ZKMultiError
+        from .protocol.records import MULTI_OPS
+
+        wire_ops = []
+        for op in ops:
+            name = op.get('op')
+            if name not in MULTI_OPS:
+                raise ValueError('unsupported multi sub-op %r'
+                                 % (name,))
+            self._check_path(op['path'])
+            sub = {'op': name, 'path': op['path']}
+            if name == 'create':
+                self._check_data(op.get('data', b''))
+                sub['data'] = op.get('data', b'')
+                sub['acl'] = (list(op['acl']) if op.get('acl')
+                              else list(OPEN_ACL_UNSAFE))
+                sub['flags'] = CreateFlag(op.get('flags', 0))
+            elif name == 'set_data':
+                self._check_data(op['data'])
+                self._check_version(op.get('version', -1))
+                sub['data'] = op['data']
+                sub['version'] = op.get('version', -1)
+            else:                     # delete / check
+                self._check_version(op.get('version', -1))
+                sub['version'] = op.get('version', -1)
+            wire_ops.append(sub)
+        conn = self._conn_or_raise()
+        fut, span = self._start_op(conn, {'opcode': 'MULTI',
+                                          'ops': wire_ops})
+        pkt = await self._await_op(fut, 'MULTI', None, deadline, span)
+        results = pkt['results']
+        if any(r['op'] == 'error' for r in results):
+            raise ZKMultiError(results)
+        out: list = []
+        for r in results:
+            if r['op'] == 'create':
+                out.append(r['path'])
+            elif r['op'] == 'set_data':
+                out.append(r['stat'])
+            else:
+                out.append(None)
+        return out
+
+    def transaction(self) -> 'Transaction':
+        """A builder for one MULTI transaction::
+
+            t = client.transaction()
+            t.create('/a', b'x').set('/b', b'y').delete('/old')
+            results = await t.commit()
+        """
+        return Transaction(self)
+
     def watcher(self, path: str) -> ZKWatcher:
         self._check_path(path)
         sess = self.get_session()
@@ -650,3 +715,38 @@ class Client(FSM):
             # The client is closing or closed.
             raise ZKNotConnectedError()
         return sess.watcher(path)
+
+
+class Transaction:
+    """Builder sugar over :meth:`Client.multi` (the kazoo/Curator
+    transaction shape): queue sub-ops, then ``await commit()`` — the
+    whole batch applies as one server transaction or not at all."""
+
+    def __init__(self, client: Client):
+        self._client = client
+        self.ops: list[dict] = []
+
+    def create(self, path: str, data: bytes = b'', acl=None,
+               flags: CreateFlag | int = 0) -> 'Transaction':
+        self.ops.append({'op': 'create', 'path': path, 'data': data,
+                         'acl': acl, 'flags': flags})
+        return self
+
+    def set(self, path: str, data: bytes,
+            version: int = -1) -> 'Transaction':
+        self.ops.append({'op': 'set_data', 'path': path, 'data': data,
+                         'version': version})
+        return self
+
+    def delete(self, path: str, version: int = -1) -> 'Transaction':
+        self.ops.append({'op': 'delete', 'path': path,
+                         'version': version})
+        return self
+
+    def check(self, path: str, version: int) -> 'Transaction':
+        self.ops.append({'op': 'check', 'path': path,
+                         'version': version})
+        return self
+
+    async def commit(self, deadline=_USE_DEFAULT) -> list:
+        return await self._client.multi(self.ops, deadline=deadline)

@@ -889,6 +889,12 @@ class FaultPlan:
     #: strictly higher epoch within the bounded wait, with invariant
     #: 7 replaying the election records afterwards
     elections: int = 0
+    #: forced MULTI batches (store.py ``ZKDatabase.multi``): evenly
+    #: spaced steps each fire one all-or-nothing batch over fresh
+    #: paths, recorded whatever the outcome — invariant 8
+    #: (check_multi_atomic) then demands whole-or-nothing visibility
+    #: in the final tree AND across the crash-image recovery
+    multis: int = 0
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -910,6 +916,9 @@ class FaultPlan:
         # not perturb the transport/plan draws existing seeds pin
         erng = random.Random('plan-elect/%d' % (seed,))
         plan.elections = erng.choice([0, 0, 0, 1, 2])
+        # same rule again for the MULTI pillar (PR 12)
+        mrng = random.Random('plan-multi/%d' % (seed,))
+        plan.multis = mrng.choice([0, 1, 1, 2])
         return plan
 
     def forced_election_steps(self) -> set[int]:
@@ -919,6 +928,15 @@ class FaultPlan:
             return set()
         return {((k + 1) * self.ops) // (self.elections + 1)
                 for k in range(self.elections)}
+
+    def forced_multi_steps(self) -> set[int]:
+        """The plan steps that fire a MULTI batch (evenly spaced,
+        before the drawn action; may share a step with a forced
+        election — both then run)."""
+        if self.multis <= 0:
+            return set()
+        return {((2 * k + 1) * self.ops) // (2 * self.multis + 1)
+                for k in range(self.multis)}
 
 
 class EnsembleUnderTest:
@@ -1227,12 +1245,34 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             h.acked_set('/w', 0, sid(), zxid=last_zxid())
         await do_create('/seq', b'')
 
+        async def do_multi(i: int) -> None:
+            """One forced all-or-nothing batch over fresh paths:
+            create two nodes and overwrite the first, as ONE txn.
+            Recorded whatever the outcome — invariant 8 demands
+            whole-or-nothing visibility either way."""
+            a, b = '/m%da' % (i,), '/m%db' % (i,)
+            za, yb = b'z%d' % (i,), b'y%d' % (i,)
+            ops_ = [{'op': 'create', 'path': a, 'data': b'x'},
+                    {'op': 'create', 'path': b, 'data': yb},
+                    {'op': 'set_data', 'path': a, 'data': za}]
+            h.multi_batch([('create', a, za), ('create', b, yb)],
+                          session_id=sid())
+            ok, _ = await bounded(client.multi(ops_),
+                                  'multi %d' % (i,), op='multi')
+            if ok:
+                res.acked += 1
+                h.acked_create(a, za, sid(), zxid=last_zxid())
+                h.acked_create(b, yb, sid(), zxid=last_zxid())
+
         forced_steps = plan.forced_election_steps()
+        multi_steps = plan.forced_multi_steps()
         for i in range(plan.ops):
             await wait_usable(1.5)
             res.ops += 1
             if i in forced_steps:
                 await force_election()
+            if i in multi_steps:
+                await do_multi(i)
             act = inj.choice('plan', PLAN_ACTIONS)
             if act == 'set':
                 set_idx += 1

@@ -56,6 +56,16 @@ failing seed's report reads without the source):
    epoch means a deposed leader's era could be mistaken for current.
    Invariants 1 and 6 run unchanged across elections — failover must
    not lose an acked write.
+8. **Quorum-commit era** (PR 12) — three strengthenings: invariant
+   1/6 take a ``quorum_zxid`` floor under which acks are NEVER
+   demoted (a majority of mirrors ingested the txn before its ack
+   left — server/replication.py QuorumGate);
+   :func:`check_session_continuity` asserts a session that stayed
+   inside its timeout across a full restart keeps its identity and
+   its ephemerals (durable sessions, server/persist.py); and
+   :func:`check_multi_atomic` asserts no MULTI batch is ever
+   partially visible — in the live tree or across a torn-record
+   recovery (one CRC frame per batch).
 
 The history is plain data (a list of dicts) so it can ride a JSON
 trace dump next to the span ring; :func:`format_history` renders the
@@ -114,6 +124,17 @@ class History:
         return self._add('ack', op='set', path=path, index=index,
                          session_id=session_id, zxid=zxid)
 
+    def multi_batch(self, subs: list, session_id: int = 0,
+                    zxid: int | None = None) -> dict:
+        """One ATTEMPTED MULTI batch: ``subs`` is ``[(op, path,
+        data)]`` (data None where the op carries none), recorded
+        whatever the outcome — acked, rejected or outcome-unknown —
+        because atomicity binds them all: invariant 8
+        (:func:`check_multi_atomic`) demands the batch be visible
+        whole or not at all."""
+        return self._add('multi', subs=list(subs),
+                         session_id=session_id, zxid=zxid)
+
     def ambiguous(self, op: str, path: str | None,
                   session_id: int = 0,
                   sequential_parent: str | None = None) -> dict:
@@ -156,7 +177,8 @@ class History:
 
 
 def check_acked_durability(history: History, db,
-                           floor_zxid: int | None = None) -> list[str]:
+                           floor_zxid: int | None = None,
+                           quorum_zxid: int | None = None) -> list[str]:
     """Invariant 1: no acked write lost.  ``db`` is the leader
     ZKDatabase (reads bypass the wire; faults are stopped).
 
@@ -164,7 +186,16 @@ def check_acked_durability(history: History, db,
     acks sequenced past the newest *known-durable* zxid — possible
     only when an fsync failed under them — are demoted to their
     outcome-unknown form instead of enforced; ``None`` enforces every
-    ack."""
+    ack.
+
+    ``quorum_zxid`` (quorum-commit, server/replication.py
+    QuorumGate): the strengthened form — an ack at or under the
+    quorum floor is NEVER demoted, whatever the fsync floor says: a
+    majority of mirrors ingested the txn before the ack left, so it
+    must survive a leader death regardless of the leader's own disk.
+    Only meaningful where quorum ack implies a surviving copy (the
+    OS-process tier's mirror WALs; the in-process ensemble's replicas
+    share the one crash image and keep floor semantics)."""
     from ..server.store import ZKOpError
 
     out: list[str] = []
@@ -180,7 +211,10 @@ def check_acked_durability(history: History, db,
     for r in history.records:
         if r['kind'] == 'ack':
             if floor_zxid is not None and (
-                    r.get('zxid') is None or r['zxid'] > floor_zxid):
+                    r.get('zxid') is None or r['zxid'] > floor_zxid) \
+                    and not (quorum_zxid is not None
+                             and r.get('zxid') is not None
+                             and r['zxid'] <= quorum_zxid):
                 # past the durable floor: this ack's txn may not have
                 # reached disk before the crash — demote, do not
                 # enforce (it may legitimately be present OR absent)
@@ -252,7 +286,8 @@ def check_acked_durability(history: History, db,
 
 
 def check_durable_recovery(history: History, db,
-                           floor_zxid: int | None = None) -> list[str]:
+                           floor_zxid: int | None = None,
+                           quorum_zxid: int | None = None) -> list[str]:
     """Invariant 6 (the durability plane, server/persist.py): after a
     full-ensemble SIGKILL, a database recovered from the newest valid
     snapshot plus the replayed WAL tail still holds every
@@ -261,21 +296,32 @@ def check_durable_recovery(history: History, db,
     — an outcome-unknown write may or may not have reached the log —
     plus the ``floor_zxid`` demotion for acks an fsync error left
     non-durable (``None`` = every ack was fsynced before it left,
-    the sync='always'/'tick' barrier contract).  Ephemeral absence is
-    excused as in invariant 1: a full crash kills every session, so
-    recovery reaps them by logged deletes."""
+    the sync='always'/'tick' barrier contract) and the
+    ``quorum_zxid`` strengthening (acks at or under the quorum floor
+    are never demoted — invariant 1's docstring says when that is
+    sound).  Ephemeral absence is excused as in invariant 1 when the
+    owning session died with the crash; a session recovered live
+    keeps its ephemerals (:func:`check_session_continuity` asserts
+    that side)."""
     out = ['durability: %s' % v
            for v in check_acked_durability(history, db,
-                                           floor_zxid=floor_zxid)]
+                                           floor_zxid=floor_zxid,
+                                           quorum_zxid=quorum_zxid)]
     top = 0
     for r in history.of_kind('ack'):
         z = r.get('zxid')
-        if z and (floor_zxid is None or z <= floor_zxid):
+        if z and (floor_zxid is None or z <= floor_zxid
+                  or (quorum_zxid is not None and z <= quorum_zxid)):
             top = max(top, z)
     if db.zxid < top:
         out.append('durability: recovered zxid %d is behind the '
                    'newest durable acked zxid %d (log tail lost)'
                    % (db.zxid, top))
+    # a multi past the durable floor may legitimately be absent whole
+    # — but never partial: the one-CRC-frame record guarantees torn
+    # replay is all-or-nothing, and this asserts it
+    out.extend('durability: %s' % v
+               for v in check_multi_atomic(history, db))
     return out
 
 
@@ -408,6 +454,71 @@ def check_watch_once(history: History) -> list[str]:
     return out
 
 
+def check_session_continuity(live_sessions: dict, db) -> list[str]:
+    """Invariant 8a (durable sessions, server/persist.py): a session
+    that stayed inside its timeout across a full restart keeps its
+    identity AND its ephemerals.  ``live_sessions`` is the
+    pre-restart truth, ``{sid: set(ephemeral paths)}`` captured while
+    the sessions were live; ``db`` the recovered database."""
+    out: list[str] = []
+    for sid, paths in live_sessions.items():
+        sess = db.sessions.get(sid)
+        if sess is None or sess.expired or sess.closed:
+            out.append(
+                'session %016x did not survive restart inside its '
+                'timeout (%s)' % (sid,
+                                  'missing' if sess is None else
+                                  'expired' if sess.expired
+                                  else 'closed'))
+            continue
+        for path in sorted(paths):
+            node = db.nodes.get(path)
+            if node is None:
+                out.append(
+                    'ephemeral %s of surviving session %016x lost '
+                    'across restart' % (path, sid))
+            elif node.ephemeral_owner != sid:
+                out.append(
+                    'ephemeral %s re-owned across restart: %016x, '
+                    'expected %016x' % (path, node.ephemeral_owner,
+                                        sid))
+            elif path not in sess.ephemerals:
+                out.append(
+                    'ephemeral %s missing from recovered session '
+                    '%016x ephemeral set' % (path, sid))
+    return out
+
+
+def check_multi_atomic(history: History, db) -> list[str]:
+    """Invariant 8b (MULTI, server/store.py ``ZKDatabase.multi``): no
+    partial batch is ever visible — for each acked multi, either every
+    sub-effect is present in the final tree or none is (a torn multi
+    record replays atomically or not at all).  Sub-effects are judged
+    by (op, path, data); the caller keeps batch paths unmutated
+    outside their batch, as the seeded scenarios do."""
+    out: list[str] = []
+    for r in history.of_kind('multi'):
+        vis: list[bool] = []
+        for op, path, data in r['subs']:
+            node = db.nodes.get(path)
+            if op == 'create':
+                vis.append(node is not None and (
+                    data is None or bytes(node.data) == data))
+            elif op == 'delete':
+                vis.append(node is None)
+            elif op == 'set_data':
+                vis.append(node is not None
+                           and bytes(node.data) == data)
+        if any(vis) and not all(vis):
+            missing = [r['subs'][i][1] for i, v in enumerate(vis)
+                       if not v]
+            out.append(
+                'multi batch (t=%d, %d ops) partially visible: '
+                'effect(s) missing at %s — a multi must apply whole '
+                'or not at all' % (r['t'], len(vis), missing))
+    return out
+
+
 def check_election(history: History) -> list[str]:
     """Invariant 7: at most one elected leader per epoch, and elected
     epochs strictly increase in history order."""
@@ -445,6 +556,7 @@ def check_history(history: History, db) -> list[str]:
     out.extend(check_sequential(history))
     out.extend(check_watch_once(history))
     out.extend(check_election(history))
+    out.extend(check_multi_atomic(history, db))
     return out
 
 

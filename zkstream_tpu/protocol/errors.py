@@ -74,3 +74,25 @@ class ZKError(Exception):
             self.errno: int | None = int(ErrCode[code])
         except KeyError:
             self.errno = None
+
+
+class ZKMultiError(ZKError):
+    """A MULTI transaction was rejected: no sub-op was applied
+    (all-or-nothing, server/store.py ``ZKDatabase.multi``).  ``code``
+    is the first failing sub-op's error; ``results`` holds the per-op
+    outcome dicts exactly as the wire carried them (failed ops as
+    ``{'op': 'error', 'err': <code>}``), and ``index`` names the first
+    failing position."""
+
+    def __init__(self, results: list):
+        self.results = results
+        self.index = next(
+            (i for i, r in enumerate(results) if r.get('op') == 'error'
+             and r.get('err') not in (None, 'OK',
+                                      'RUNTIME_INCONSISTENCY')),
+            next((i for i, r in enumerate(results)
+                  if r.get('op') == 'error'), 0))
+        code = (results[self.index].get('err', 'API_ERROR')
+                if results else 'API_ERROR')
+        super().__init__(code, 'multi rejected at op %d: %s (no sub-op '
+                               'was applied)' % (self.index, code))

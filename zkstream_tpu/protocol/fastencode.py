@@ -55,6 +55,9 @@ _RESP_BUF_HDR = struct.Struct('>iiqii')
 _RESP_STAT = struct.Struct('>iiqiqqqqiiiqiiq')
 #: reply header + notification type + state + path length.
 _NOTIF_HDR = struct.Struct('>iiqiiii')
+#: one jute MultiHeader: int type | bool done | int err.
+_MULTI_HDR = struct.Struct('>ibi')
+_MULTI_END = _MULTI_HDR.pack(-1, 1, -1)
 
 _ERRNUM = {e.name: int(e) for e in ErrCode}
 _NOTIFNUM = {t.name: int(t) for t in NotificationType}
@@ -177,6 +180,60 @@ class FastEncoder:
             pb, _INT.pack(dn if dn else -1), d, ab,
             _INT.pack(int(fl))))
 
+    def _multi_sub_body(self, op: dict) -> bytes | None:
+        """One MULTI sub-op request body (no header), single pass;
+        None for any shape the spec tier must judge."""
+        name = op['op']
+        p = op['path']
+        if type(p) is not str:
+            return None
+        pb = p.encode('utf-8')
+        n = len(pb)
+        if name in ('delete', 'check'):
+            return b''.join((_INT.pack(n if n else -1), pb,
+                             _INT.pack(op['version'])))
+        if name == 'set_data':
+            d = op['data']
+            dn = len(d)
+            return b''.join((_INT.pack(n if n else -1), pb,
+                             _INT.pack(dn if dn else -1), d,
+                             _INT.pack(op['version'])))
+        if name == 'create':
+            d = op['data']
+            fl = op.get('flags', 0)
+            if not isinstance(fl, int) or not 0 <= fl <= 3:
+                return None
+            acl = op['acl']
+            if acl is records.OPEN_ACL_UNSAFE:
+                ab = _OPEN_ACL_BYTES
+            else:
+                ab = _acl_bytes(acl)
+                if ab is None:
+                    return None
+            dn = len(d)
+            return b''.join((_INT.pack(n if n else -1), pb,
+                             _INT.pack(dn if dn else -1), d, ab,
+                             _INT.pack(int(fl))))
+        return None
+
+    def _rq_multi(self, pkt, opnum):
+        parts = [b'']                 # [0] holds the framed header
+        size = 8
+        for op in pkt['ops']:
+            t = records.MULTI_OPS.get(op['op'])
+            if t is None:
+                return None
+            body = self._multi_sub_body(op)
+            if body is None:
+                return None
+            parts.append(_MULTI_HDR.pack(t, 0, -1))
+            parts.append(body)
+            size += 9 + len(body)
+        parts.append(_MULTI_END)
+        size += 9
+        parts[0] = _REQ_HDR.pack(size, pkt['xid'], opnum)
+        return b''.join(parts)
+
     # -- responses (server direction) --
 
     def encode_response(self, pkt: dict) -> bytes | None:
@@ -259,6 +316,43 @@ class FastEncoder:
                                   pkt['zxid'], 0)
         return b''.join(parts)
 
+    def _rs_multi(self, pkt):
+        parts = [b'']                 # [0] holds the reply header
+        size = 0
+        for res in pkt['results']:
+            name = res['op']
+            if name == 'error':
+                code = _ERRNUM[res['err']]
+                parts.append(_MULTI_HDR.pack(-1, 0, code))
+                parts.append(_INT.pack(code))
+                size += 13
+                continue
+            t = records.MULTI_OPS.get(name)
+            if t is None:
+                return None
+            parts.append(_MULTI_HDR.pack(t, 0, 0))
+            size += 9
+            if name == 'create':
+                p = res['path']
+                if type(p) is not str:
+                    return None
+                pb = p.encode('utf-8')
+                n = len(pb)
+                parts.append(_INT.pack(n if n else -1))
+                parts.append(pb)
+                size += 4 + n
+            elif name == 'set_data':
+                st = res['stat']
+                if len(st) != 11:
+                    return None
+                parts.append(_STAT.pack(*st))
+                size += 68
+        parts.append(_MULTI_END)
+        size += 9
+        parts[0] = _RESP_HDR.pack(16 + size, pkt['xid'],
+                                  pkt['zxid'], 0)
+        return b''.join(parts)
+
     def _rs_get_acl(self, pkt):
         acl = pkt['acl']
         ab = (_OPEN_ACL_BYTES if acl is records.OPEN_ACL_UNSAFE
@@ -286,6 +380,7 @@ _REQ_FAST = {
     'GET_ACL': (FastEncoder._rq_path, int(OpCode.GET_ACL)),
     'SET_DATA': (FastEncoder._rq_set_data, int(OpCode.SET_DATA)),
     'SYNC': (FastEncoder._rq_path, int(OpCode.SYNC)),
+    'MULTI': (FastEncoder._rq_multi, int(OpCode.MULTI)),
     'CLOSE_SESSION': (FastEncoder._rq_bare, int(OpCode.CLOSE_SESSION)),
     'PING': (FastEncoder._rq_bare, int(OpCode.PING)),
 }
@@ -300,4 +395,5 @@ _RESP_FAST = {
     'NOTIFICATION': FastEncoder._rs_notification,
     'EXISTS': FastEncoder._rs_stat_only,
     'SET_DATA': FastEncoder._rs_stat_only,
+    'MULTI': FastEncoder._rs_multi,
 }

@@ -215,7 +215,13 @@ class PacketCodec:
         """
         if self._ext is not None and not self.handshaking:
             return self._decode_ext(chunk)
-        pkts: list[dict] = []
+        return self._decode_scalar(chunk, [])
+
+    def _decode_scalar(self, chunk: bytes,
+                       pkts: list[dict]) -> list[dict]:
+        """The pure-Python decode loop, appending into ``pkts`` (the
+        spec tier; also the continuation the extension path hands the
+        buffer to when it punts an opcode it carries no layout for)."""
         for body in self._decoder.feed(chunk):
             r = JuteReader(body)
             try:
@@ -274,6 +280,14 @@ class PacketCodec:
             raise err
         if consumed:
             del buf[:consumed]
+        if kind == 'UNSUPPORTED':
+            # the head of the buffer is a complete frame whose opcode
+            # the C tier carries no layout for (MULTI): the spec tier
+            # takes over from here — it decodes the frame (or raises
+            # the spec's own precise error) and everything behind it
+            # in this chunk, with the scalar path's exact buffer and
+            # error semantics; the next chunk re-enters the C tier
+            return self._decode_scalar(b'', pkts)
         if kind is not None:
             err = ZKProtocolError(kind, msg)
             err.packets = pkts

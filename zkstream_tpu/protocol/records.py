@@ -202,6 +202,134 @@ def _read_set_data(r: JuteReader, pkt: dict) -> None:
     pkt['version'] = r.read_int()
 
 
+def _write_check(w: JuteWriter, pkt: dict) -> None:
+    w.write_ustring(pkt['path'])
+    w.write_int(pkt['version'])
+
+
+def _read_check(r: JuteReader, pkt: dict) -> None:
+    pkt['path'] = r.read_ustring()
+    pkt['version'] = r.read_int()
+
+
+# -- MULTI (opcode 14): all-or-nothing transactions --------------------
+#
+# The jute MultiHeader framing (upstream ZooKeeper MultiTransactionRecord
+# / MultiResponse; the reference client never implemented opcode 14 —
+# its consts table stops at naming it): each sub-op travels as
+# ``int type | bool done | int err`` followed by the op body, terminated
+# by a header with ``type == -1, done == True``.  Request sub-op bodies
+# reuse the single-op request shapes (create / delete / setData /
+# check); response results carry the single-op reply bodies for OK
+# results and an ``int err`` body (type -1) for error results.  The
+# whole batch is ONE frame, ONE server transaction, ONE WAL record
+# (server/store.py ``ZKDatabase.multi``).
+
+#: Sub-ops a MULTI may carry, by wire type number.
+MULTI_OPS = {
+    'create': int(OpCode.CREATE),
+    'delete': int(OpCode.DELETE),
+    'set_data': int(OpCode.SET_DATA),
+    'check': int(OpCode.CHECK),
+}
+_MULTI_OP_NAMES = {v: k for k, v in MULTI_OPS.items()}
+
+_MULTI_SUB_WRITERS = {
+    'create': _write_create,
+    'delete': _write_delete,
+    'set_data': _write_set_data,
+    'check': _write_check,
+}
+_MULTI_SUB_READERS = {
+    'create': _read_create,
+    'delete': _read_delete,
+    'set_data': _read_set_data,
+    'check': _read_check,
+}
+
+
+def _write_multi_header(w: JuteWriter, type_: int, done: bool,
+                        err: int) -> None:
+    w.write_int(type_)
+    w.write_bool(done)
+    w.write_int(err)
+
+
+def _write_multi(w: JuteWriter, pkt: dict) -> None:
+    for op in pkt['ops']:
+        name = op['op']
+        if name not in MULTI_OPS:
+            raise ValueError('unsupported multi sub-op %r' % (name,))
+        _write_multi_header(w, MULTI_OPS[name], False, -1)
+        _MULTI_SUB_WRITERS[name](w, op)
+    _write_multi_header(w, -1, True, -1)
+
+
+def _read_multi(r: JuteReader, pkt: dict) -> None:
+    ops: list[dict] = []
+    while True:
+        type_ = r.read_int()
+        done = r.read_bool()
+        r.read_int()                  # err: always -1 in requests
+        if done:
+            if type_ != -1:
+                raise ValueError(
+                    'multi terminator carries type %d' % (type_,))
+            break
+        name = _MULTI_OP_NAMES.get(type_)
+        if name is None:
+            raise ValueError('unsupported multi sub-op type %d'
+                             % (type_,))
+        sub: dict = {'op': name}
+        _MULTI_SUB_READERS[name](r, sub)
+        ops.append(sub)
+    pkt['ops'] = ops
+
+
+def _read_multi_resp(r: JuteReader, pkt: dict) -> None:
+    results: list[dict] = []
+    while True:
+        type_ = r.read_int()
+        done = r.read_bool()
+        err = r.read_int()
+        if done:
+            break
+        if type_ == -1:
+            # ErrorResult: the body repeats the error code as an int
+            r.read_int()
+            results.append({'op': 'error', 'err': err_name(err)})
+            continue
+        name = _MULTI_OP_NAMES.get(type_)
+        if name is None:
+            raise ValueError('unsupported multi result type %d'
+                             % (type_,))
+        res: dict = {'op': name}
+        if name == 'create':
+            res['path'] = r.read_ustring()
+        elif name == 'set_data':
+            res['stat'] = read_stat(r)
+        results.append(res)           # delete / check: header only
+    pkt['results'] = results
+
+
+def _write_multi_resp(w: JuteWriter, pkt: dict) -> None:
+    for res in pkt['results']:
+        name = res['op']
+        if name == 'error':
+            code = int(ErrCode[res['err']])
+            _write_multi_header(w, -1, False, code)
+            w.write_int(code)
+            continue
+        if name not in MULTI_OPS:
+            raise ValueError('unsupported multi result %r' % (name,))
+        _write_multi_header(w, MULTI_OPS[name], False, 0)
+        if name == 'create':
+            w.write_ustring(res['path'])
+        elif name == 'set_data':
+            write_stat(w, res['stat'])
+    _write_multi_header(w, -1, True, -1)
+
+
 #: The three watch lists in a SET_WATCHES body, in wire order
 #: (reference: lib/zk-buffer.js:233-273).
 SET_WATCHES_KINDS = ('dataChanged', 'createdOrDestroyed', 'childrenChanged')
@@ -236,6 +364,7 @@ _REQ_WRITERS = {
     'SET_DATA': _write_set_data,
     'SYNC': _write_path,
     'SET_WATCHES': _write_set_watches,
+    'MULTI': _write_multi,
     # Header-only requests (reference: lib/zk-buffer.js:129-132):
     'CLOSE_SESSION': None,
     'PING': None,
@@ -252,6 +381,7 @@ _REQ_READERS = {
     'SET_DATA': _read_set_data,
     'SYNC': _read_path,
     'SET_WATCHES': _read_set_watches,
+    'MULTI': _read_multi,
     'CLOSE_SESSION': None,
     'PING': None,
 }
@@ -331,6 +461,7 @@ _RESP_READERS = {
     'NOTIFICATION': _read_notification,
     'EXISTS': _read_stat_only_resp,
     'SET_DATA': _read_stat_only_resp,
+    'MULTI': _read_multi_resp,
 }
 
 
@@ -409,6 +540,7 @@ _RESP_WRITERS = {
     'NOTIFICATION': _write_notification,
     'EXISTS': _write_stat_only_resp,
     'SET_DATA': _write_stat_only_resp,
+    'MULTI': _write_multi_resp,
 }
 
 

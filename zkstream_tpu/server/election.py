@@ -56,7 +56,7 @@ import time
 from ..io.backoff import BackoffPolicy
 from ..utils.aio import ambient_loop
 from ..utils.events import EventEmitter
-from .replication import _dump, _read_msg
+from .replication import _dump, _read_msg, quorum_of
 
 log = logging.getLogger('zkstream_tpu.server.election')
 
@@ -121,10 +121,6 @@ def tally(votes) -> Vote | None:
     if not votes:
         return None
     return max(votes)
-
-
-def quorum_of(total: int) -> int:
-    return total // 2 + 1
 
 
 def _promise_path(d: str) -> str:
@@ -601,6 +597,7 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
         recover_state,
         reset_dir,
         restore_sequential_counters,
+        restore_sessions,
     )
     from .replication import (
         RemoteLeader,
@@ -664,6 +661,11 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                 db.log_start_zxid = db.zxid
                 src.close()
                 attach_wal(db, wal)
+                # durable sessions survive the failover: the mirror's
+                # replicated session table seats into the new leader
+                # database (fresh expiry clocks; a client that
+                # resumes inside the timeout keeps its ephemerals)
+                restore_sessions(db, src.session_snapshot())
             elif led_db is not None:
                 # a deposed ex-leader re-winning (the successor era
                 # ended before this member ever re-followed): its own
@@ -680,7 +682,12 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             new_epoch = max(target_epoch, db.epoch + 1)
             db.bump_epoch(new_epoch)
             reap_orphan_ephemerals(db)
-            svc = await ReplicationService(db).start()
+            # quorum-commit: the whole membership is the voter set,
+            # so a write acked through THIS leader is majority-held
+            # before the ack leaves (follower acks piggyback
+            # applied_zxid on the replication channels)
+            svc = await ReplicationService(
+                db, total=len(peers) + 1).start()
             state['epoch'] = new_epoch
             state['zxid_fn'] = lambda db=db: db.zxid
             store = None
@@ -688,11 +695,14 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             led_db = None
             peer.note_leading(svc.port)
             if server is None:
-                announce(await ZKServer(
-                    db, port=client_port,
-                    member='m%d' % (member_id,)).start())
+                srv = ZKServer(db, port=client_port,
+                               member='m%d' % (member_id,))
+                srv.quorum = svc.quorum
+                announce(await srv.start())
             else:
+                server.quorum = svc.quorum
                 server.repoint(db, role='leader')
+            svc.quorum.trace = getattr(db, 'trace', None)
             # OS-tier fencing of DIRECT client writes: once this
             # service learns it is deposed, every write through this
             # member bounces with EPOCH_FENCED (same check the
@@ -725,6 +735,7 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                 recovered = {'zxid': store.zxid, 'nodes': store.nodes}
                 cur_epoch = remote.epoch if remote is not None \
                     else state['epoch']
+                prev_sessions = store.leader.session_snapshot()
             elif led_db is not None:
                 # a deposed ex-leader rejoining the current era: its
                 # led state is the catch-up base (the successor holds
@@ -736,17 +747,24 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                 recovered = {'zxid': led_db.zxid,
                              'nodes': led_db.nodes}
                 cur_epoch = led_db.epoch
+                prev_sessions = led_db.session_snapshot()
             else:
                 have_zxid = rec.zxid if (
                     rec.last_index or rec.snapshot_index >= 0) else None
                 recovered = ({'zxid': rec.zxid, 'nodes': rec.nodes}
                              if have_zxid is not None else None)
                 cur_epoch = rec.epoch
+                prev_sessions = rec.sessions
             if remote is not None:
                 remote.close()
             remote = RemoteLeader(host, repl_port,
                                   have_zxid=have_zxid,
                                   epoch=cur_epoch)
+            # the durable session table this member already holds (a
+            # mirror it served, a led era, or its recovered WAL)
+            # seeds the new mirror handle — resync ships only the
+            # tail, and a later promotion must keep these sessions
+            remote.seed_sessions(prev_sessions)
             # the leader-lost latch is one-shot: arm it BEFORE the
             # connect so an EOF landing while the server below is
             # still starting cannot fire into a missing callback and
@@ -799,6 +817,9 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                     remote, store=store, port=client_port,
                     member='m%d' % (member_id,)).start())
             else:
+                # a follower's acks gate on its mirror WAL alone: the
+                # quorum half belongs to the leader's RPC response
+                server.quorum = None
                 server.repoint(remote, store=store, role='follower')
             # a follower at the current epoch is not fenced: stale-
             # epoch protection for its forwarded writes lives in the
@@ -919,11 +940,14 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                workdir: str | None = None):
     """One seeded OS-process election schedule: spawn ``members``
     symmetric peer processes over per-member WAL dirs, drive a seeded
-    workload through follower members, SIGKILL the elected leader
-    ``elections`` times (each survivor set must elect a successor at
-    a strictly higher epoch, operator-free), then SIGKILL the WHOLE
-    ensemble ``generations`` times — each generation must elect from
-    recovered WALs alone and still hold every acked write.  Invariant
+    workload THROUGH THE LEADER (quorum-commit makes its ack
+    survivable), SIGKILL the elected leader ``elections`` times —
+    each kill immediately after a freshly acked marker write, which
+    must read back from the successor — (each survivor set must elect
+    a successor at a strictly higher epoch, operator-free), then
+    SIGKILL the WHOLE ensemble ``generations`` times — each
+    generation must elect from recovered WALs alone and still hold
+    every acked write.  Invariant
     7 (at-most-one-leader-per-epoch, epoch monotonicity) is checked
     over the recorded history; violations carry the seed, rerunnable
     via ``zkstream_tpu chaos --tier process --seed N``."""
@@ -952,14 +976,16 @@ async def run_process_schedule(seed: int, ops: int = 6,
         res.elections += 1
 
     async def fresh_client(leader_id: int) -> Client:
-        """A client preferring FOLLOWER members: a write forwarded
-        through a follower is in that follower's mirror (and mirror
-        WAL) before its ack, so an acked write survives any later
-        leader SIGKILL — the guarantee this schedule asserts."""
+        """A client preferring the LEADER member: quorum-commit makes
+        the leader's own ack survivable — it leaves only once a
+        majority of mirrors has ingested the txn — so the schedule
+        writes through the leader and asserts exactly that (the
+        follower-routing workaround this schedule used to need is
+        gone)."""
         backends = [('127.0.0.1', m.client_port) for m in fleet
-                    if m.alive() and m.member_id != leader_id]
+                    if m.alive() and m.member_id == leader_id]
         backends += [('127.0.0.1', m.client_port) for m in fleet
-                     if m.alive() and m.member_id == leader_id]
+                     if m.alive() and m.member_id != leader_id]
         c = Client(servers=backends, shuffle_backends=False,
                    session_timeout=12000, op_timeout=3000,
                    connect_policy=BackoffPolicy(timeout=2000,
@@ -1058,7 +1084,22 @@ async def run_process_schedule(seed: int, ops: int = 6,
             await workload(round_no, leader_id)
             victim = next(m for m in fleet
                           if m.member_id == leader_id)
-            h.member_event('kill-leader', leader_id)
+            # leader-killed-after-ack: one marker write THROUGH THE
+            # LEADER, then SIGKILL it the instant the ack returns —
+            # quorum-commit means the ack implies a majority of
+            # mirrors holds the txn, so it must survive the election
+            # and read back from the successor (verify below)
+            c = await fresh_client(leader_id)
+            try:
+                path = '/killmark%d' % (round_no,)
+                data = b'k%d' % (round_no,)
+                await retrying(lambda: c.create(path, data))
+                expected[path] = data
+                h.acked_create(path, data, 0)
+                res.acked += 1
+            finally:
+                await c.close()
+            h.member_event('kill-leader-after-ack', leader_id)
             victim.kill()
             # the survivors elect with no operator; the dead member
             # respawns over its own WAL and must rejoin as follower

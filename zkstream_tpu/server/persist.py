@@ -131,9 +131,22 @@ def crc32c(data: bytes, crc: int = 0) -> int:
 
 #: Tag 4 ('epoch') is a *control* record — a leadership-epoch bump
 #: (server/election.py), logged for recovery but never applied to the
-#: tree and never entered into the replication log.
-_TAGS = {'create': 1, 'delete': 2, 'set_data': 3, 'epoch': 4}
+#: tree and never entered into the replication log.  Tags 5/6
+#: ('session' / 'session_close') are the durable-session records:
+#: session lifecycle rides the WAL (and the replication log — a
+#: follower's mirror must carry the table for failover) but never
+#: touches the tree; they carry the zxid CURRENT at the edge, consume
+#: none, and recovery filters them by log index, not zxid.  Tag 7
+#: ('multi') is one all-or-nothing transaction: every sub-entry in
+#: ONE CRC-framed record, so a torn multi replays atomically or not
+#: at all.
+_TAGS = {'create': 1, 'delete': 2, 'set_data': 3, 'epoch': 4,
+         'session': 5, 'session_close': 6, 'multi': 7}
 _OPS = {v: k for k, v in _TAGS.items()}
+
+#: ('session_close', sid, zxid, reason) reason byte values.
+_CLOSE_REASONS = {'close': 0, 'expire': 1}
+_CLOSE_NAMES = {v: k for k, v in _CLOSE_REASONS.items()}
 
 _REC_HDR = struct.Struct('>II')       # length, crc32c(body)
 _I = struct.Struct('>i')
@@ -145,12 +158,17 @@ _Q2 = struct.Struct('>qq')
 MAX_RECORD = 64 * 1024 * 1024
 
 MAGIC_SEGMENT = b'ZKSWAL1\n'
-#: Snapshot format 2 adds the leadership epoch to the stamp (a
+#: Snapshot format 3 puts the SESSION TABLE into the image (payload
+#: becomes ``{'nodes': ..., 'sessions': {sid: (passwd, timeout)}}``)
+#: so ephemerals survive a full-ensemble restart inside the session
+#: timeout.  Format 2 added the leadership epoch to the stamp (a
 #: snapshot that anchors truncation may be the only survivor of the
-#: epoch record it covers).  Format-1 images stay READABLE (epoch 0):
-#: truncation may already have deleted the segments under an existing
-#: snapshot, so rejecting it would orphan the acked writes it covers.
-MAGIC_SNAPSHOT = b'ZKSSNP2\n'
+#: epoch record it covers).  OLDER FORMATS STAY READABLE (epoch 0 /
+#: empty session table): truncation may already have deleted the
+#: segments under an existing snapshot, so rejecting it would orphan
+#: the acked writes it covers.
+MAGIC_SNAPSHOT = b'ZKSSNP3\n'
+MAGIC_SNAPSHOT_V2 = b'ZKSSNP2\n'
 MAGIC_SNAPSHOT_V1 = b'ZKSSNP1\n'
 _SNAP_HDR = struct.Struct('>QQQI')    # index, zxid, epoch, crc32(payload)
 _SNAP_HDR_V1 = struct.Struct('>QQI')  # index, zxid, crc32(payload)
@@ -158,8 +176,9 @@ _SNAP_HDR_V1 = struct.Struct('>QQI')  # index, zxid, crc32(payload)
 
 def entry_zxid(entry: tuple) -> int:
     """The zxid a commit-log entry was sequenced at (store.py shapes:
-    create[5], delete[2], set_data[3]; epoch control records carry the
-    zxid current at the bump — they consume no zxid themselves)."""
+    create[5], delete[2], set_data[3]; epoch and session control
+    records carry the zxid current at the edge — they consume no zxid
+    themselves; a multi is positioned at its LAST sub-entry's zxid)."""
     op = entry[0]
     if op == 'create':
         return entry[5]
@@ -167,8 +186,12 @@ def entry_zxid(entry: tuple) -> int:
         return entry[2]
     if op == 'set_data':
         return entry[3]
-    if op == 'epoch':
+    if op in ('epoch', 'session_close'):
         return entry[2]
+    if op == 'session':
+        return entry[4]
+    if op == 'multi':
+        return entry_zxid(entry[1][-1])
     raise ValueError('unknown log entry %r' % (op,))
 
 
@@ -182,6 +205,25 @@ def _spec_encode_entry(entry: tuple) -> bytes:
         _, epoch, zxid = entry
         w.write_long(epoch)
         w.write_long(zxid)
+        return w.to_bytes()
+    if op == 'session':
+        _, sid, passwd, timeout, zxid = entry
+        w.write_long(sid)
+        w.write_buffer(passwd)
+        w.write_int(timeout)
+        w.write_long(zxid)
+        return w.to_bytes()
+    if op == 'session_close':
+        _, sid, zxid, reason = entry
+        w.write_long(sid)
+        w.write_long(zxid)
+        w.write_byte(_CLOSE_REASONS[reason])
+        return w.to_bytes()
+    if op == 'multi':
+        subs = entry[1]
+        w.write_int(len(subs))
+        for sub in subs:
+            w.write_buffer(_spec_encode_entry(sub))
         return w.to_bytes()
     if op == 'create':
         _, path, data, acl, eph_owner, zxid, now = entry
@@ -228,6 +270,23 @@ def encode_entry(entry: tuple) -> bytes:
                          _Q2.pack(zxid, now)))
     if op == 'epoch':
         return b'\x04' + _Q2.pack(entry[1], entry[2])
+    if op == 'session':
+        _, sid, passwd, timeout, zxid = entry
+        return b''.join((b'\x05', struct.pack('>q', sid),
+                         _buf(passwd), _I.pack(timeout),
+                         struct.pack('>q', zxid)))
+    if op == 'session_close':
+        _, sid, zxid, reason = entry
+        return (b'\x06' + _Q2.pack(sid, zxid)
+                + bytes((_CLOSE_REASONS[reason],)))
+    if op == 'multi':
+        subs = entry[1]
+        parts = [b'\x07', _I.pack(len(subs))]
+        for sub in subs:
+            body = encode_entry(sub)
+            parts.append(_I.pack(len(body)))
+            parts.append(body)
+        return b''.join(parts)
     if op == 'create':
         _, path, data, acl, eph_owner, zxid, now = entry
         p = path.encode('utf-8')
@@ -278,6 +337,28 @@ def decode_entry(body: bytes) -> tuple:
         return ('delete', r.read_ustring(), r.read_long())
     if op == 'epoch':
         return ('epoch', r.read_long(), r.read_long())
+    if op == 'session':
+        return ('session', r.read_long(), bytes(r.read_buffer()),
+                r.read_int(), r.read_long())
+    if op == 'session_close':
+        sid, zxid = r.read_long(), r.read_long()
+        reason = _CLOSE_NAMES.get(r.read_byte())
+        if reason is None:
+            raise ValueError('unknown session-close reason')
+        return ('session_close', sid, zxid, reason)
+    if op == 'multi':
+        n = r.read_int()
+        # bounded by what can physically fit (a sub-record is at least
+        # its 4-byte length prefix + 1-byte tag)
+        if not 0 < n <= len(body) // 5:
+            raise ValueError('insane multi sub-count %d' % (n,))
+        subs = []
+        for _ in range(n):
+            sub = decode_entry(bytes(r.read_buffer()))
+            if sub[0] not in ('create', 'delete', 'set_data'):
+                raise ValueError('control record inside a multi')
+            subs.append(sub)
+        return ('multi', tuple(subs))
     return ('set_data', r.read_ustring(), bytes(r.read_buffer()),
             r.read_long(), r.read_long())
 
@@ -324,6 +405,9 @@ class SnapshotInfo:
     error: str | None = None
     #: leadership epoch at capture (format 2 stamp)
     epoch: int = 0
+    #: live sessions at capture, {sid: (passwd, timeout)} (format 3
+    #: payload; empty for older images)
+    sessions: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -384,10 +468,16 @@ def _read_snapshot(path: str, load_nodes: bool = True) -> SnapshotInfo:
     try:
         with open(path, 'rb') as f:
             buf = f.read()
+        dict_payload = False
         if buf.startswith(MAGIC_SNAPSHOT):
             index, zxid, epoch, crc = _SNAP_HDR.unpack_from(
                 buf, len(MAGIC_SNAPSHOT))
             body_off = len(MAGIC_SNAPSHOT) + _SNAP_HDR.size
+            dict_payload = True       # {'nodes', 'sessions'}
+        elif buf.startswith(MAGIC_SNAPSHOT_V2):
+            index, zxid, epoch, crc = _SNAP_HDR.unpack_from(
+                buf, len(MAGIC_SNAPSHOT_V2))
+            body_off = len(MAGIC_SNAPSHOT_V2) + _SNAP_HDR.size
         elif buf.startswith(MAGIC_SNAPSHOT_V1):
             # pre-election format: no epoch in the stamp
             index, zxid, crc = _SNAP_HDR_V1.unpack_from(
@@ -399,11 +489,18 @@ def _read_snapshot(path: str, load_nodes: bool = True) -> SnapshotInfo:
         payload = buf[body_off:]
         if zlib.crc32(payload) & 0xFFFFFFFF != crc:
             raise ValueError('snapshot payload fails CRC')
-        nodes = pickle.loads(payload) if load_nodes else None
-        if load_nodes and '/' not in nodes:
-            raise ValueError('snapshot image has no root')
+        nodes, sessions = None, {}
+        if load_nodes:
+            image = pickle.loads(payload)
+            if dict_payload:
+                nodes = image['nodes']
+                sessions = image.get('sessions', {})
+            else:
+                nodes = image
+            if '/' not in nodes:
+                raise ValueError('snapshot image has no root')
         return SnapshotInfo(path, index, zxid, True, nodes,
-                            epoch=epoch)
+                            epoch=epoch, sessions=sessions)
     except Exception as e:
         # parse the stamp out of the filename so the CLI can still
         # list the corrupt file next to its intended position
@@ -458,6 +555,12 @@ class Recovery:
     #: control records, whichever is higher) — what a recovered
     #: member votes with (server/election.py)
     epoch: int = 0
+    #: sessions alive at the crash, {sid: (passwd, timeout)} — the
+    #: snapshot's table plus the session control records replayed by
+    #: log index; :func:`restore_sessions` re-arms them with a fresh
+    #: expiry clock so ephemerals survive a restart inside the
+    #: session timeout
+    sessions: dict = dataclasses.field(default_factory=dict)
 
 
 def recover_state(path: str, trace=None) -> Recovery:
@@ -478,6 +581,7 @@ def recover_state(path: str, trace=None) -> Recovery:
     base_zxid = tree.zxid
     base_index = snap.index if snap is not None else 0
     epoch = snap.epoch if snap is not None else 0
+    sessions = dict(snap.sessions) if snap is not None else {}
     replayed = 0
     torn = False
     last_index = base_index
@@ -500,6 +604,18 @@ def recover_state(path: str, trace=None) -> Recovery:
                 # not apply — a bump consumes no zxid), never applied
                 # to the tree
                 epoch = max(epoch, entry[1])
+                last_index = max(last_index, idx + 1)
+                continue
+            if entry[0] in ('session', 'session_close'):
+                # session control records carry the zxid current at
+                # the edge, so the zxid filter cannot place them:
+                # filter by LOG INDEX against the snapshot stamp (the
+                # image's session table covers everything before it)
+                if idx >= base_index:
+                    if entry[0] == 'session':
+                        sessions[entry[1]] = (entry[2], entry[3])
+                    else:
+                        sessions.pop(entry[1], None)
                 last_index = max(last_index, idx + 1)
                 continue
             if entry_zxid(entry) <= base_zxid:
@@ -525,7 +641,7 @@ def recover_state(path: str, trace=None) -> Recovery:
                    snapshot_index=snap.index if snap else -1,
                    snapshot_zxid=snap.zxid if snap else 0,
                    replayed=replayed, torn=torn, detail=detail,
-                   epoch=epoch)
+                   epoch=epoch, sessions=sessions)
     if trace is not None:
         trace.note('WAL_RECOVER', path=path, zxid=rec.zxid,
                    kind='recovery',
@@ -554,6 +670,9 @@ def _restore_seq(tree, entry) -> None:
     parent.seq lagged would hand out an already-used number."""
     if entry[0] == 'create':
         _advance_seq(tree, entry[1])
+    elif entry[0] == 'multi':
+        for sub in entry[1]:
+            _restore_seq(tree, sub)
 
 
 # ---------------------------------------------------------------------
@@ -1082,7 +1201,13 @@ class WriteAheadLog:
             return False
         index, zxid = self.next_index, tree.zxid
         epoch = getattr(tree, 'epoch', 0)
-        payload = pickle.dumps(tree.nodes,
+        # format 3: the session table enters the image (captured in
+        # the same synchronous tick as the stamp), so a restart inside
+        # the session timeout keeps sessions — and their ephemerals
+        snap_sessions = getattr(tree, 'session_snapshot',
+                                lambda: {})()
+        payload = pickle.dumps({'nodes': tree.nodes,
+                                'sessions': snap_sessions},
                                protocol=pickle.HIGHEST_PROTOCOL)
         final = os.path.join(self.dir, 'snap.%016d' % (index,))
         tmp = final + '.tmp'
@@ -1272,12 +1397,44 @@ def restore_sequential_counters(tree) -> None:
         _advance_seq(tree, path)
 
 
+def restore_sessions(db, sessions: dict) -> int:
+    """Re-seat recovered sessions into a leader database: each gets a
+    live :class:`~.store.ZKServerSession` with its ephemeral set
+    rebuilt from the recovered tree and a FRESH expiry clock — a
+    client that resumes inside the timeout keeps its session (and its
+    ephemerals); one that never returns expires normally, and the
+    expiry replays the ephemeral deletes as logged writes, exactly
+    like real ZK's timeout-based expiry replay.  Outside a loop the
+    clock stays unarmed until the first touch (unit-test contexts)."""
+    from .store import ZKServerSession
+
+    for sid, (passwd, timeout) in sessions.items():
+        sess = ZKServerSession(id=sid, passwd=passwd, timeout=timeout)
+        db.sessions[sid] = sess
+    if sessions:
+        for path, node in db.nodes.items():
+            sess = db.sessions.get(node.ephemeral_owner) \
+                if node.ephemeral_owner else None
+            if sess is not None:
+                sess.ephemerals.add(path)
+        for sess in db.sessions.values():
+            try:
+                db.touch_session(sess)
+            except RuntimeError:
+                break                 # no loop: clocks start later
+    return len(sessions)
+
+
 def reap_orphan_ephemerals(db) -> int:
-    """Delete recovered ephemerals whose owning session did not
-    survive (a full-ensemble crash kills every session; real ZK
-    replays the same deletes when the sessions' timeouts lapse).
-    The deletes are sequenced and logged like any write, so a second
-    crash cannot resurrect them."""
+    """Delete recovered ephemerals whose owning session did NOT
+    survive the crash — i.e. is absent from the recovered session
+    table (closed/expired before the crash, or never durably
+    created).  Sessions that *were* live stay live (restored with
+    fresh expiry clocks by :func:`restore_sessions`), so their
+    ephemerals survive a restart inside the session timeout; if the
+    client never resumes, the normal expiry path reaps them by logged
+    deletes.  The reaping deletes here are sequenced and logged like
+    any write, so a second crash cannot resurrect them."""
     orphans = [p for p, n in db.nodes.items()
                if n.ephemeral_owner
                and n.ephemeral_owner not in db.sessions]
@@ -1309,6 +1466,9 @@ def open_wal_database(path: str, *, sync: str = 'tick',
                         segment_age_s=segment_age_s,
                         collector=collector, faults=faults)
     attach_wal(db, wal)
+    # sessions first: a recovered-live session keeps its ephemerals
+    # (the restart-inside-timeout guarantee); only dead ones reap
+    restore_sessions(db, rec.sessions)
     reap_orphan_ephemerals(db)
     return db
 

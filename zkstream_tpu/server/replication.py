@@ -47,15 +47,23 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
 
 from ..protocol.consts import CreateFlag
 from ..utils.events import EventEmitter
 from .persist import entry_zxid
-from .store import ReplicaStore, ZKDatabase, ZKOpError, ZKServerSession
+from .store import (
+    ReplicaStore,
+    ZKDatabase,
+    ZKOpError,
+    ZKServerSession,
+    durable_sessions,
+)
 
 log = logging.getLogger('zkstream_tpu.server.replication')
 
@@ -112,6 +120,311 @@ def _recv_msg(sock: socket.socket):
     return pickle.loads(out)
 
 
+# ---------------------------------------------------------------------
+# Quorum-commit: the leader's ack means a majority holds the write.
+# ---------------------------------------------------------------------
+
+METRIC_QUORUM_ACK = 'zk_quorum_ack_ms'
+QUORUM_ACK_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                      25.0, 50.0, 100.0, 250.0)
+
+#: How long a gated flush waits for quorum before DEGRADING — the
+#: release-on-attempt philosophy of the WAL's fsync gate: a quorum the
+#: ensemble cannot currently assemble (partition, parked followers)
+#: must delay acks, never wedge every reply forever.  Degraded
+#: releases are counted (``degraded_releases``, mntr
+#: ``zk_quorum_degraded``) and the quorum floor does NOT advance, so
+#: the invariant engine's no-demotion rule stays honest.
+DEFAULT_QUORUM_WAIT_MS = 250.0
+
+
+def quorum_enabled() -> bool:
+    """Global kill switch (mirrors ``ZKSTREAM_NO_WAL`` /
+    ``ZKSTREAM_NO_ELECTION``): the fsync-only ack barrier stays
+    available as the A/B validator arm (``bench.py --quorum``)."""
+    return os.environ.get('ZKSTREAM_NO_QUORUM') != '1'
+
+
+def quorum_wait_ms() -> float:
+    try:
+        v = float(os.environ.get('ZKSTREAM_QUORUM_WAIT_MS', ''))
+    except ValueError:
+        return DEFAULT_QUORUM_WAIT_MS
+    return v if v > 0 else DEFAULT_QUORUM_WAIT_MS
+
+
+def quorum_of(total: int) -> int:
+    return total // 2 + 1
+
+
+class QuorumGate:
+    """The quorum half of the leader's ack barrier.
+
+    Before this gate, a write acked THROUGH THE LEADER died with the
+    leader: only the leader's tree (and WAL) held it, and
+    ``run_process_schedule`` routed writes through followers purely to
+    keep the no-acked-write-lost invariant honest.  The gate closes
+    that gap: every follower ack piggybacks its mirror's newest
+    ``applied_zxid`` (and accepted epoch) on the existing replication
+    channels, and the leader's send plane holds a corked tick's acks —
+    alongside the WAL's group fsync, one wait for both
+    (:class:`CommitBarrier`) — until a majority of the ``total``
+    membership (the leader's own vote included) holds every txn the
+    tick acked.
+
+    Fencing: an ack stamped with an epoch below the database's current
+    one is a deposed era's — dropped and counted (``stale_acks``), so
+    a partitioned ex-follower's late acks can never count toward a new
+    epoch's quorum.
+
+    Liveness: a quorum the ensemble cannot assemble degrades after
+    ``wait_ms`` (:data:`DEFAULT_QUORUM_WAIT_MS`) — the corked acks
+    leave quorum-unconfirmed, ``degraded_releases`` counts it, and the
+    quorum floor stays put.  A single-member ensemble (``total < 2``)
+    needs no gate at all: the leader IS the majority."""
+
+    def __init__(self, db, total: int, *, enabled: bool | None = None,
+                 collector=None, wait_ms: float | None = None):
+        self.db = db
+        self.total = total
+        self.enabled = ((quorum_enabled() if enabled is None
+                         else enabled) and total >= 2)
+        self.wait_ms = wait_ms if wait_ms is not None \
+            else quorum_wait_ms()
+        #: voter key -> newest acked zxid (follower token / member id;
+        #: the leader's own vote is ``db.zxid``, never stored here)
+        self.acked: dict = {}
+        self.stale_acks = 0
+        self.degraded_releases = 0
+        #: newest zxid a majority is known to hold (cached; advanced
+        #: by :meth:`note_ack`)
+        self.quorum_zxid_floor = 0
+        #: newest zxid already RELEASED unconfirmed by a degrade: the
+        #: gate must not re-block later (read-only) ticks on a write
+        #: that already left — each NEW write gets its own bounded
+        #: wait, never a standing stall
+        self.degraded_zxid = 0
+        #: Optional utils/trace.TraceRing: the floor advancing leaves
+        #: a ``QUORUM_ACK`` span between WAL_APPEND and the client ack
+        #: in the zxid-keyed chain.
+        self.trace = None
+        self._waiters: list = []      # send-plane releases
+        self._futs: list = []         # (target_zxid, Future) rpc waits
+        self._timer = None
+        self._commit_t: dict[int, float] = {}
+        self._hist = None
+        if collector is not None:
+            self.bind_metrics(collector)
+
+    def bind_metrics(self, collector) -> None:
+        self._hist = collector.histogram(
+            METRIC_QUORUM_ACK,
+            'Commit to majority-ack latency, ms',
+            buckets=QUORUM_ACK_BUCKETS)
+
+    # -- feed --
+
+    def note_pushed(self, zxid: int) -> None:
+        """Stamp a commit's push time (latency measurement base for
+        the zk_quorum_ack_ms histogram; bounded)."""
+        if self.enabled and zxid not in self._commit_t \
+                and len(self._commit_t) < 4096:
+            self._commit_t[zxid] = time.monotonic()
+
+    def note_ack(self, voter, zxid: int,
+                 epoch: int | None = None) -> None:
+        """One follower's piggybacked applied-zxid ack.  Epoch-fenced:
+        a stale era's ack never counts toward the current quorum."""
+        if not self.enabled:
+            return
+        if epoch is not None and epoch < getattr(self.db, 'epoch', 0):
+            self.stale_acks += 1
+            return
+        if zxid <= self.acked.get(voter, 0):
+            return
+        self.acked[voter] = zxid
+        self._advance()
+
+    def forget(self, voter) -> None:
+        """A follower detached: its standing vote leaves the pool
+        (it can rejoin by acking again)."""
+        self.acked.pop(voter, None)
+
+    def quorum_zxid(self) -> int:
+        """The newest zxid a majority of the membership holds (the
+        leader's own ``db.zxid`` is one vote)."""
+        if not self.enabled:
+            return self.db.zxid
+        pool = sorted([self.db.zxid] + list(self.acked.values()),
+                      reverse=True)
+        need = quorum_of(self.total)
+        return pool[need - 1] if len(pool) >= need else 0
+
+    def _floor_with_grant(self, grant, target: int) -> int:
+        """The quorum floor with ``grant``'s vote counted virtually
+        at ``target``: the forwarded-write RPC path — the calling
+        follower's loop is parked inside the blocking RPC, but the
+        response's own piggyback delivers the txn into its mirror
+        before the client can see the ack, so its vote is guaranteed
+        by construction, not awaited (awaiting it would deadlock a
+        two-member ensemble into the degrade timeout per write)."""
+        pool = [self.db.zxid]
+        if grant is not None:
+            pool.append(target)
+        pool += [z for v, z in self.acked.items() if v != grant]
+        pool.sort(reverse=True)
+        need = quorum_of(self.total)
+        return pool[need - 1] if len(pool) >= need else 0
+
+    def _advance(self) -> None:
+        floor = self.quorum_zxid()
+        if floor <= self.quorum_zxid_floor:
+            return
+        self.quorum_zxid_floor = floor
+        now = time.monotonic()
+        covered = [z for z in self._commit_t if z <= floor]
+        for z in covered:
+            dur_ms = (now - self._commit_t.pop(z)) * 1000.0
+            if self._hist is not None:
+                self._hist.observe(dur_ms)
+        if self.trace is not None:
+            self.trace.note('QUORUM_ACK', zxid=floor, kind='server',
+                            batch=max(1, len(covered)))
+        if floor >= self.db.zxid:
+            # every committed txn is majority-held: corked acks leave
+            self._release(degraded=False)
+        for target, fut, grant in self._futs[:]:
+            if not fut.done() and \
+                    self._floor_with_grant(grant, target) >= target:
+                fut.set_result(True)
+        self._futs = [e for e in self._futs if not e[1].done()]
+
+    # -- the ack gate (composed with the WAL by CommitBarrier) --
+
+    def gate_flush(self, release) -> bool:
+        """True when every committed txn is majority-held — the
+        corked acks may leave.  Otherwise the flush stays corked,
+        ``release`` re-flushes when the quorum floor reaches the
+        current zxid, and the degrade timer bounds the wait."""
+        if not self.enabled:
+            return True
+        if self.quorum_zxid() >= self.db.zxid \
+                or self.db.zxid <= self.degraded_zxid:
+            return True
+        self._waiters.append(release)
+        self._arm_timer()
+        return False
+
+    def sync_for_flush(self) -> None:
+        """The synchronous barrier half is the WAL's alone: quorum
+        acks arrive on the events channel THIS loop serves, so a hard
+        flush (fault-injected delivery, connection close) cannot
+        block on them — those frames leave fsynced-but-unconfirmed,
+        exactly like a degraded release."""
+
+    def _arm_timer(self) -> None:
+        if self._timer is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop to deliver acks on either: degrade immediately —
+            # and mark the floor BEFORE releasing, or the released
+            # flush re-gates into this branch forever (the release IS
+            # flush_now, which re-runs gate_flush synchronously)
+            self.degraded_releases += 1
+            self.degraded_zxid = self.db.zxid
+            self._release(degraded=True)
+            return
+        self._timer = loop.call_later(self.wait_ms / 1000.0,
+                                      self._degrade)
+
+    def _degrade(self) -> None:
+        self._timer = None
+        if self._waiters and self.quorum_zxid() < self.db.zxid:
+            self.degraded_releases += 1
+            self.degraded_zxid = self.db.zxid
+            log.warning('quorum wait degraded after %.0f ms (floor '
+                        'zxid %d, leader zxid %d): acks leave '
+                        'quorum-unconfirmed', self.wait_ms,
+                        self.quorum_zxid_floor, self.db.zxid)
+        self._release(degraded=True)
+
+    def _release(self, degraded: bool) -> None:
+        if self._timer is not None and not degraded:
+            self._timer.cancel()
+            self._timer = None
+        waiters, self._waiters = self._waiters, []
+        for release in waiters:
+            try:
+                release()
+            except Exception:  # pragma: no cover - plane teardown
+                log.exception('quorum gate release failed')
+
+    async def wait(self, target_zxid: int,
+                   timeout_s: float | None = None,
+                   grant=None) -> bool:
+        """Await the quorum floor reaching ``target_zxid`` (the
+        forwarded-write RPC path): True on quorum, False on the
+        degrade timeout.  ``grant`` is the calling follower's voter
+        key, counted virtually at the target (see
+        :meth:`_floor_with_grant`)."""
+        if not self.enabled \
+                or self._floor_with_grant(grant, target_zxid) \
+                >= target_zxid:
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        self._futs.append((target_zxid, fut, grant))
+        try:
+            await asyncio.wait_for(
+                fut, (timeout_s if timeout_s is not None
+                      else self.wait_ms / 1000.0))
+            return True
+        except (asyncio.TimeoutError, TimeoutError):
+            self.degraded_releases += 1
+            return False
+        finally:
+            self._futs = [e for e in self._futs if e[1] is not fut]
+
+    def close(self) -> None:
+        # disable BEFORE releasing: a release re-enters gate_flush,
+        # and a closed gate must gate nothing (re-registering here
+        # would arm a fresh degrade timer on a gate being torn down)
+        self.enabled = False
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._release(degraded=True)
+
+
+class CommitBarrier:
+    """The leader ack barrier: WAL group fsync AND quorum ack, taken
+    together — a corked tick registers one release with each and
+    flushes when both clear (io/sendplane.py ``barrier`` contract).
+    Either half may be absent (WAL-less bench arms, quorum-disabled
+    validator)."""
+
+    __slots__ = ('wal', 'quorum')
+
+    def __init__(self, wal, quorum):
+        self.wal = wal
+        self.quorum = quorum
+
+    def gate_flush(self, release) -> bool:
+        # call BOTH gates unconditionally: the fsync and the quorum
+        # round-trip overlap instead of serializing
+        wal_clear = self.wal is None or self.wal.gate_flush(release)
+        q_clear = (self.quorum is None
+                   or self.quorum.gate_flush(release))
+        return wal_clear and q_clear
+
+    def sync_for_flush(self) -> None:
+        if self.wal is not None:
+            self.wal.sync_for_flush()
+        if self.quorum is not None:
+            self.quorum.sync_for_flush()
+
+
 class _FollowerHandle:
     """The leader-side stand-in for one remote follower in the
     database's replica registry.  ``applied`` is what the follower has
@@ -134,10 +447,17 @@ class ReplicationService:
     on the same ``db`` for the leader *member*."""
 
     def __init__(self, db: ZKDatabase, host: str = '127.0.0.1',
-                 port: int = 0):
+                 port: int = 0, total: int = 1, collector=None,
+                 quorum: bool | None = None):
         self.db = db
         self.host = host
         self.port = port
+        #: Quorum-commit (the leader's ack barrier): ``total`` is the
+        #: ENSEMBLE membership (this leader included), so a
+        #: standalone service (total=1) carries a disabled gate — the
+        #: leader is its own majority.
+        self.quorum = QuorumGate(db, total, enabled=quorum,
+                                 collector=collector)
         self._server: asyncio.base_events.Server | None = None
         self._handles: dict[str, _FollowerHandle] = {}
         #: every open follower transport, severed on stop(): since
@@ -191,6 +511,7 @@ class ReplicationService:
         return self
 
     async def stop(self) -> None:
+        self.quorum.close()
         if self._server is not None:
             self._server.close()
             for w in list(self._writers):
@@ -235,6 +556,7 @@ class ReplicationService:
 
     def _push_commits(self) -> None:
         trace = getattr(self.db, 'trace', None)
+        self.quorum.note_pushed(self.db.zxid)
         for h in self._handles.values():
             base, entries = self._entries_from(h.shipped)
             if entries:
@@ -308,8 +630,14 @@ class ReplicationService:
                     if pos is None:
                         pos = self.db.attach_replica_at_tail(h)
                         h.applied = h.shipped = pos
+                        # the image carries the SESSION TABLE too:
+                        # session records before the bootstrap
+                        # position were never retained, and a
+                        # promoted ex-follower must not expire every
+                        # client (store.py session_snapshot)
                         self._push(h, ('snapshot', self.db.snapshot(),
-                                       pos, self.epoch))
+                                       pos, self.epoch,
+                                       self.db.session_snapshot()))
                         log.info('follower %s joined late: snapshot '
                                  'at log index %d (zxid %d)', token,
                                  pos, self.db.zxid)
@@ -324,22 +652,29 @@ class ReplicationService:
             self._push_commits()
             try:
                 # the follower acks mirrored indices on this channel;
-                # acks are what advance the truncation floor
+                # acks are what advance the truncation floor, and the
+                # piggybacked (applied_zxid, epoch) pair is what
+                # advances the quorum-commit floor
                 while True:
                     msg = await _read_msg(reader)
                     if msg[0] == 'ack':
                         h.applied = max(h.applied, msg[1])
+                        if len(msg) > 2:
+                            self.quorum.note_ack(
+                                h.token, msg[2],
+                                msg[3] if len(msg) > 3 else None)
             except (asyncio.IncompleteReadError, ConnectionError):
                 pass                         # EOF = follower died
             finally:
                 self._detach(h)
         elif kind == 'control':
-            await self._serve_control(reader, writer)
+            await self._serve_control(reader, writer, token)
         else:  # pragma: no cover - only this module speaks the protocol
             writer.close()
 
     def _detach(self, h: _FollowerHandle) -> None:
         self._handles.pop(h.token, None)
+        self.quorum.forget(h.token)
         if h in self.db._replicas:
             self.db._replicas.remove(h)
         if h.writer is not None:
@@ -348,7 +683,8 @@ class ReplicationService:
         log.info('follower %s detached', h.token)
 
     async def _serve_control(self, reader: asyncio.StreamReader,
-                             writer: asyncio.StreamWriter) -> None:
+                             writer: asyncio.StreamWriter,
+                             token: str | None = None) -> None:
         db = self.db
         try:
             while True:
@@ -369,18 +705,38 @@ class ReplicationService:
                     self.depose(rpc_epoch)
                 if (self.deposed or (rpc_epoch is not None
                                      and rpc_epoch < self.epoch)) \
-                        and method in ('create', 'delete', 'set_data'):
+                        and method in ('create', 'delete', 'set_data',
+                                       'multi'):
                     # epoch fence: a deposed leader must not apply —
                     # or ack — a forwarded write, and a stale-epoch
                     # follower's write must bounce until it rejoins
                     # the current epoch.  Typed, never silent.
                     status, payload = 'err', 'EPOCH_FENCED'
                 else:
+                    pre_zxid = db.zxid
                     status, payload = self._dispatch(method, args)
                     if db.wal is not None:
                         # logged-before-ack across processes too: a
                         # forwarded write's RPC response is its ack
                         db.wal.sync_for_flush()
+                    if status == 'ok' and db.zxid > pre_zxid \
+                            and method in (
+                            'create', 'delete', 'set_data', 'multi'):
+                        # the zxid guard skips writes that committed
+                        # nothing (a rejected multi reports per-op
+                        # errors under status 'ok'; a check-only
+                        # batch consumes no zxid) — they must not
+                        # stall on unrelated in-flight writes' quorum
+                        # quorum-before-ack: the response leaves only
+                        # once a majority holds the txn.  The CALLING
+                        # follower's vote is granted virtually — this
+                        # very response's piggyback delivers the txn
+                        # into its mirror before the client can see
+                        # the ack (its loop is parked in the blocking
+                        # RPC, so awaiting its real ack would
+                        # deadlock).  Bounded: degrades like the
+                        # send-plane gate.
+                        await self.quorum.wait(db.zxid, grant=token)
                 base, entries = self._entries_from(have)
                 writer.write(_dump(
                     ('res', seq, status, payload, base, entries,
@@ -403,6 +759,9 @@ class ReplicationService:
                 return 'ok', None
             if method == 'set_data':
                 return 'ok', db.set_data(*args)
+            if method == 'multi':
+                ops, sid = args
+                return 'ok', db.multi(ops, db.sessions.get(sid))
             if method == 'create_session':
                 sess = db.create_session(args[0])
                 return 'ok', (sess.id, sess.passwd, sess.timeout)
@@ -615,6 +974,7 @@ class RemoteLeader(EventEmitter):
                         assert not self.log, 'snapshot after entries'
                         self._snapshot = (msg[1], msg[2])
                         self.log_base = msg[2]
+                    self.seed_sessions(msg[4] if len(msg) > 4 else {})
                 elif msg[0] == 'resync':
                     # the leader accepted have_zxid as the catch-up
                     # base: no image — the recovered tree stands and
@@ -664,10 +1024,15 @@ class RemoteLeader(EventEmitter):
                     for e in tail:
                         self.wal.append(e)
             acked = self.log_end()
+            acked_zxid = entry_zxid(self.log[-1]) if self.log else 0
         if tail and self._events_writer is not None:
             # the ack rides the events transport, which belongs to the
-            # loop: schedule the write there when called off-loop
-            data = _dump(('ack', acked))
+            # loop: schedule the write there when called off-loop.
+            # The piggybacked (applied_zxid, epoch) pair is the
+            # quorum-commit vote: the leader's ack barrier releases
+            # once a majority of mirrors has ingested the txn, and an
+            # ack stamped with a stale epoch is fenced out.
+            data = _dump(('ack', acked, acked_zxid, self.epoch))
 
             def send():
                 try:
@@ -732,10 +1097,33 @@ class RemoteLeader(EventEmitter):
     def set_data(self, path, data, version):
         return self._rpc('set_data', path, data, version)
 
+    def multi(self, ops, session=None):
+        """Forward one all-or-nothing MULTI batch; the leader applies
+        it as ONE transaction (store.py ``ZKDatabase.multi``) and the
+        RPC piggyback delivers the whole ('multi', subs) entry into
+        this mirror before the ack, like any forwarded write."""
+        sid = session.id if session is not None else 0
+        return self._rpc('multi', list(ops), sid)
+
     def sync_barrier(self) -> None:
         """Round-trip to the leader; on return the mirror holds every
         transaction the leader had committed when the RPC arrived."""
         self._rpc('sync_barrier')
+
+    def seed_sessions(self, table: dict) -> None:
+        """Seed the mirror's session table from a durable form
+        (``{sid: (passwd, timeout)}``): the leader's bootstrap image,
+        or this member's own recovered table on rejoin.  Existing
+        handles win — they may already carry lifecycle state."""
+        for sid, (passwd, timeout) in table.items():
+            if sid not in self.sessions:
+                self.sessions[sid] = ZKServerSession(
+                    id=sid, passwd=passwd, timeout=timeout)
+
+    def session_snapshot(self) -> dict:
+        """The mirror's session table in durable form — what a
+        promoted ex-follower seats into its new leader database."""
+        return durable_sessions(self.sessions)
 
     def _session(self, sid: int, passwd: bytes,
                  timeout: int) -> ZKServerSession:
@@ -785,6 +1173,26 @@ class RemoteReplicaStore(ReplicaStore):
       the write RPC's piggyback already delivered the mirror through
       the write, and a second blocking round-trip per write would
       stall the member's whole event loop."""
+
+    def _apply_session(self, entry: tuple) -> None:
+        """Session control records replicate the leader's session
+        table into THIS follower's mirror handle — what keeps every
+        session alive across an OS-process leader failover: the
+        promoted member seats ``leader.sessions`` into its new
+        database instead of expiring every client."""
+        sessions = self.leader.sessions
+        if entry[0] == 'session':
+            _, sid, passwd, timeout, _zxid = entry
+            if sid not in sessions:
+                sessions[sid] = ZKServerSession(
+                    id=sid, passwd=passwd, timeout=timeout)
+        else:
+            sess = sessions.get(entry[1])
+            if sess is not None:
+                if entry[3] == 'expire':
+                    sess.expired = True
+                else:
+                    sess.closed = True
 
     def __init__(self, leader: RemoteLeader, lag: float | None = 0.0,
                  recovered: dict | None = None):

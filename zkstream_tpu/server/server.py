@@ -93,11 +93,14 @@ class ServerConnection:
         #: off-loop group fsync covering their txns, so no ack byte
         #: reaches the transport before its txn is on disk and the
         #: event loop never blocks on the device (server/persist.py
-        #: sync='tick').
+        #: sync='tick').  With a quorum gate attached the barrier is
+        #: the CommitBarrier composition: the same corked tick also
+        #: waits for a majority of mirrors to hold the txns — one
+        #: wait covers both halves (server/replication.py).
         self._tx = SendPlane(self._tx_write, enabled=server.cork,
                              max_bytes=server.flush_cap,
                              collector=server.collector, plane='server',
-                             barrier=getattr(server.db, 'wal', None),
+                             barrier=server.ack_barrier,
                              ledger=server.ledger,
                              tier=server.transport_tier,
                              transport_fn=lambda: getattr(
@@ -475,6 +478,19 @@ class ServerConnection:
         acl, stat = self.store.get_acl(pkt['path'])
         self._reply(pkt['xid'], 'GET_ACL', acl=acl, stat=stat)
 
+    def _op_multi(self, pkt: dict) -> None:
+        """One all-or-nothing MULTI transaction (opcode 14): the
+        whole batch is ONE leader transaction — one WAL record, one
+        group-fsync slot, one replication push element (store.py
+        ``ZKDatabase.multi``).  The reply always decodes a result
+        body: a rejected batch carries per-op error results (the
+        failing op's code, RUNTIME_INCONSISTENCY elsewhere) with NO
+        sub-op applied."""
+        self._check_fence()
+        results = self.db.multi(pkt['ops'], self.session)
+        self.store.catch_up()
+        self._reply(pkt['xid'], 'MULTI', results=results)
+
     def _op_sync(self, pkt: dict) -> None:
         # Flush replication: this member applies everything the leader
         # has committed before replying, so a read issued after the
@@ -688,6 +704,27 @@ class ZKServer:
         self.fence = None
         self.elections = 0
         self.elections_ref = None
+        #: Quorum-commit gate (server/replication.py QuorumGate):
+        #: when attached, accepted connections' acks gate on it
+        #: ALONGSIDE the WAL's group fsync (CommitBarrier) — a corked
+        #: tick waits once for both.  A ZKEnsemble wires one shared
+        #: gate over its follower stores; the OS-process leader wires
+        #: its ReplicationService's.  None = fsync-only barrier (the
+        #: standalone / validator arm).
+        self.quorum = None
+
+    @property
+    def ack_barrier(self):
+        """What accepted connections' send planes gate acks on: the
+        database's WAL (group fsync), composed with the quorum gate
+        when one is attached — ack-order contract: no reply byte may
+        reach the transport before BOTH have cleared."""
+        wal = getattr(self.db, 'wal', None)
+        q = self.quorum
+        if q is not None and q.enabled:
+            from .replication import CommitBarrier
+            return CommitBarrier(wal, q)
+        return wal
 
     def encode_notification(self, ntype: str, path: str,
                             zxid: int) -> bytes:
@@ -878,6 +915,24 @@ class ZKServer:
             ('zk_wal_sync_errors', wal.sync_errors),
             ('zk_wal_snapshots', wal.snapshots_taken),
         ]
+        # quorum-commit rows (server/replication.py QuorumGate): the
+        # majority floor, degraded (quorum-unconfirmed) releases and
+        # epoch-fenced stale acks
+        q = self.quorum
+        quorum_rows = [] if q is None or not q.enabled else [
+            ('zk_quorum_members', q.total),
+            ('zk_quorum_zxid', '0x%x' % (q.quorum_zxid_floor,)),
+            ('zk_quorum_degraded', q.degraded_releases),
+            ('zk_quorum_stale_acks', q.stale_acks),
+        ]
+        # MULTI rows: batches applied and mean batch width
+        batches = getattr(self.db, 'multi_batches', 0)
+        subops = getattr(self.db, 'multi_subops', 0)
+        multi_rows = [
+            ('zk_multi_batches', batches),
+            ('zk_multi_batch_size',
+             round(subops / batches, 2) if batches else 0),
+        ]
         # the tick ledger + trace-ring rows (the per-tick plane
         # decomposition, README "Causal tracing"): tick count, each
         # phase's per-tick p99, and how often the bounded span ring
@@ -909,6 +964,9 @@ class ZKServer:
             ('zk_ephemerals_count', ephemerals),
             ('zk_approximate_data_size', data_size),
             ('zk_sessions', len(self.db.sessions)),
+            ('zk_session_table_size',
+             sum(1 for s in self.db.sessions.values()
+                 if not s.expired and not s.closed)),
             ('zk_zxid', '0x%x' % (self.store.zxid,)),
             ('zk_fanout_shards',
              0 if self.watch_table is None
@@ -916,7 +974,7 @@ class ZKServer:
             ('zk_transport_backend',
              'asyncio' if self.transport_tier is None
              else self.transport_tier.backend),
-        ] + tick_rows + wal_rows
+        ] + multi_rows + quorum_rows + tick_rows + wal_rows
 
     def admin_text(self, word: str) -> str:
         """Render one four-letter word's reply text."""
@@ -982,7 +1040,8 @@ class ZKEnsemble:
                  election: bool | None = None,
                  heartbeat_ms: int | None = None,
                  seed: int | None = None,
-                 transport: str | None = None):
+                 transport: str | None = None,
+                 quorum: bool | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -1001,6 +1060,16 @@ class ZKEnsemble:
                 self.db = ZKDatabase()
         else:
             self.db = ZKDatabase()
+        #: Quorum-commit gate built BEFORE the follower stores: its
+        #: push-time stamp must run ahead of the stores' synchronous
+        #: applies on the 'committed' edge, or every zk_quorum_ack_ms
+        #: sample would measure the gap to the NEXT commit instead.
+        from .replication import QuorumGate
+        self.quorum = QuorumGate(self.db, count, enabled=quorum,
+                                 collector=collector)
+        if self.quorum.enabled:
+            self.db.on('committed',
+                       lambda: self.quorum.note_pushed(self.db.zxid))
         self.servers = [
             ZKServer(self.db, host=host,
                      store=None if i == 0 else ReplicaStore(self.db,
@@ -1021,6 +1090,24 @@ class ZKEnsemble:
             self.servers, self.db, heartbeat_ms=heartbeat_ms,
             seed=seed, collector=collector)
             if enabled_election else None)
+        #: Quorum-commit wiring (server/replication.py QuorumGate,
+        #: constructed above the servers list): the leader's ack
+        #: gates on a majority of follower stores having applied the
+        #: txn, alongside the WAL's group fsync — on by default at
+        #: >= 2 members (``quorum=False`` / ``ZKSTREAM_NO_QUORUM=1``
+        #: keeps the fsync-only barrier as the A/B validator arm).
+        #: Each follower store's apply hook is its piggybacked
+        #: applied-zxid vote.
+        if self.quorum.enabled:
+            gate = self.quorum
+            for s in self.servers:
+                s.quorum = gate
+            for i in range(1, count):
+                self.servers[i].store.on_applied = (
+                    lambda z, v='member:%d' % i:
+                    gate.note_ack(v, z, self.db.epoch))
+            # QUORUM_ACK spans land on the founding leader's ring
+            gate.trace = self.servers[0].trace
 
     @property
     def leader_idx(self) -> int:
@@ -1054,6 +1141,7 @@ class ZKEnsemble:
         ``wal_dir`` is the restart-from-disk path."""
         if self.election is not None:
             self.election.stop()
+        self.quorum.close()
         for s in self.servers:
             await s.stop()
         if self.db.wal is not None:

@@ -110,6 +110,23 @@ def validate_path(path: str) -> None:
         raise ZKOpError('BAD_ARGUMENTS')
 
 
+def durable_sessions(sessions: dict) -> dict:
+    """A session table's durable form — the ONE definition of what a
+    format-3 snapshot stamps, a mirror seeds and a promotion seats
+    (server/persist.py, server/replication.py):
+    ``{sid: (passwd, timeout)}``, live sessions only."""
+    return {sid: (s.passwd, s.timeout) for sid, s in sessions.items()
+            if not s.expired and not s.closed}
+
+
+def _copy_znode(node: 'Znode | None') -> 'Znode | None':
+    """A rollback-grade copy: every scalar field plus a fresh children
+    set (data bytes and the ACL tuple are immutable and may alias)."""
+    if node is None:
+        return None
+    return dataclasses.replace(node, children=set(node.children))
+
+
 class NodeTree(EventEmitter):
     """A znode tree plus the deterministic transaction-apply primitives
     shared by the leader and every replica — one code path mutates all
@@ -130,10 +147,23 @@ class NodeTree(EventEmitter):
     #: attribute test.
     trace = None
 
+    #: When set (``ZKDatabase.multi``), change events buffer here
+    #: instead of dispatching: a speculative multi apply must not fire
+    #: watches it may roll back.  Class-level None keeps the normal
+    #: emit path a single attribute test.
+    _event_buf = None
+
     def __init__(self) -> None:
         super().__init__()
         self.nodes: dict[str, Znode] = {'/': Znode()}
         self.zxid = 0
+
+    def emit(self, event: str, *args) -> None:
+        buf = self._event_buf
+        if buf is not None:
+            buf.append((event, args))
+            return
+        super().emit(event, *args)
 
     # -- snapshot (late-joining replica bootstrap) --
 
@@ -172,8 +202,29 @@ class NodeTree(EventEmitter):
         elif op == 'set_data':
             _, path, data, zxid, now = entry
             self._apply_set_data(path, data, zxid, now)
+        elif op == 'multi':
+            # ONE all-or-nothing transaction: the subs apply in order,
+            # guarded by zxid so a replay over a fuzzy image (WAL
+            # recovery) skips the prefix the image already holds —
+            # a torn multi RECORD never reaches here at all (the CRC
+            # frame covers the whole batch, server/persist.py)
+            for sub in entry[1]:
+                if entry_zxid(sub) > self.zxid:
+                    self.apply_entry(sub)
+        elif op in ('session', 'session_close'):
+            # session control records ride the commit log (a follower
+            # mirror must carry the table for failover) but never
+            # touch the tree
+            self._apply_session(entry)
         else:  # pragma: no cover - log entries are produced above
             raise AssertionError('unknown log entry %r' % (op,))
+
+    def _apply_session(self, entry: tuple) -> None:
+        """Session-record hook.  A plain tree (WAL recovery target)
+        and an in-process replica (the shared leader database already
+        owns the table) ignore them; the cross-process mirror's
+        replica overrides this to maintain its leader-handle table
+        (server/replication.py RemoteReplicaStore)."""
 
     def _apply_create(self, path: str, data: bytes, acl: tuple,
                       ephemeral_owner: int, zxid: int, now: int) -> None:
@@ -278,6 +329,14 @@ class ZKDatabase(NodeTree):
         #: Optional write-ahead log (server/persist.py): when set,
         #: ``_commit`` appends every txn BEFORE its ack can leave.
         self.wal = None
+        #: While a MULTI is applying, committed sub-entries collect
+        #: here instead of reaching the WAL/log — on success the whole
+        #: batch commits as ONE ('multi', subs) record sharing one
+        #: group-fsync slot; on failure it rolls back untraced.
+        self._multi_buf: list | None = None
+        #: MULTI counters (mntr rows zk_multi_*).
+        self.multi_batches = 0
+        self.multi_subops = 0
         self._replicas: list['ReplicaStore'] = []
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
         self._next_session = ((int(time.time() * 1000) << 24)
@@ -346,6 +405,18 @@ class ZKDatabase(NodeTree):
         pos = self.index_after_zxid(have_zxid)
         if pos is None:
             return None
+        # session control records carry the zxid current at their
+        # edge: ones logged at exactly ``have_zxid`` AFTER the
+        # rejoiner's last mirrored txn are invisible to the zxid
+        # bisect — walk the position back over them (re-shipping a
+        # session record the rejoiner did hold is idempotent)
+        while pos > self.log_base:
+            e = self.log[pos - 1 - self.log_base]
+            if e[0] in ('session', 'session_close') \
+                    and entry_zxid(e) == have_zxid:
+                pos -= 1
+            else:
+                break
         self._replicas.append(replica)
         return pos
 
@@ -387,12 +458,18 @@ class ZKDatabase(NodeTree):
     def recover_from_disk(self) -> None:
         """Rebuild this database's state from its WAL directory — the
         in-process analogue of a leader process dying and restarting
-        (``ZKServer.restart(from_disk=True)``).  Sessions do not
-        survive a crash (their timers died with the process); their
-        ephemerals are reaped by logged deletes after the reload.
-        Standalone/leader only: attached replicas hold live trees this
-        reload would silently diverge from."""
-        from .persist import reap_orphan_ephemerals, recover_state
+        (``ZKServer.restart(from_disk=True)``).  Sessions recovered
+        LIVE from the WAL (durable session records + the snapshot's
+        table) are re-seated with fresh expiry clocks — a client
+        resuming inside the timeout keeps its session and its
+        ephemerals; only dead sessions' ephemerals are reaped, by
+        logged deletes.  Standalone/leader only: attached replicas
+        hold live trees this reload would silently diverge from."""
+        from .persist import (
+            reap_orphan_ephemerals,
+            recover_state,
+            restore_sessions,
+        )
 
         wal = self.wal
         assert wal is not None, 'recover_from_disk needs a WAL'
@@ -414,13 +491,29 @@ class ZKDatabase(NodeTree):
         # the SAME WriteAheadLog object reopens: collector-bound
         # gauges/histograms and the fault injector stay live on it
         wal.reopen()
+        restore_sessions(self, rec.sessions)
         reap_orphan_ephemerals(self)
 
     def _commit(self, entry: tuple) -> None:
-        if self.trace is not None:
-            self.trace.note('COMMIT', entry[1],
-                            zxid=entry_zxid(entry), kind='server',
-                            detail=entry[0])
+        if self._multi_buf is not None:
+            # speculative MULTI apply: held until the whole batch
+            # commits (or rolls back) — nothing reaches the WAL, the
+            # replication log or a trace ring from inside the batch
+            self._multi_buf.append(entry)
+            return
+        if self.trace is not None \
+                and entry[0] not in ('session', 'session_close'):
+            # session control records are edges, not transactions:
+            # they consume no zxid, so a COMMIT span would break the
+            # zxid-keyed chain (and stamp zxid 0 on a fresh database)
+            if entry[0] == 'multi':
+                self.trace.note('COMMIT', None,
+                                zxid=entry_zxid(entry), kind='server',
+                                detail='multi', batch=len(entry[1]))
+            else:
+                self.trace.note('COMMIT', entry[1],
+                                zxid=entry_zxid(entry), kind='server',
+                                detail=entry[0])
         # durability first: the WAL append precedes the 'committed'
         # emit (and therefore every replica push and — because the
         # handler corks the ack after this returns — every ack byte)
@@ -460,8 +553,20 @@ class ZKDatabase(NodeTree):
                                timeout=timeout)
         self.sessions[sess.id] = sess
         self.touch_session(sess)
+        # durable sessions: the edge is a WAL control record AND a
+        # replicated log entry (a follower's mirror must carry the
+        # table so a promoted leader keeps every session).  It rides
+        # the zxid current at the edge — consuming none — and
+        # recovery replays it by log index (server/persist.py).
+        self._commit(('session', sess.id, sess.passwd, sess.timeout,
+                      self.zxid))
         log.debug('created session %016x timeout %d', sess.id, timeout)
         return sess
+
+    def session_snapshot(self) -> dict:
+        """The live session table in its durable form — what a fuzzy
+        snapshot stamps (server/persist.py format 3)."""
+        return durable_sessions(self.sessions)
 
     def resume_session(self, session_id: int,
                        passwd: bytes) -> ZKServerSession | None:
@@ -491,6 +596,11 @@ class ZKDatabase(NodeTree):
             sess.expiry_handle.cancel()
             sess.expiry_handle = None
         log.info('session %016x expired', session_id)
+        # the edge is logged BEFORE the ephemeral deletes it causes:
+        # a crash between them recovers a dead session whose orphans
+        # the recovery reap replays
+        self._commit(('session_close', session_id, self.zxid,
+                      'expire'))
         self._reap_ephemerals(sess)
         self.emit('sessionExpired', session_id)
 
@@ -503,6 +613,8 @@ class ZKDatabase(NodeTree):
             sess.expiry_handle.cancel()
             sess.expiry_handle = None
         log.debug('session %016x closed', session_id)
+        self._commit(('session_close', session_id, self.zxid,
+                      'close'))
         self._reap_ephemerals(sess)
 
     def _reap_ephemerals(self, sess: ZKServerSession) -> None:
@@ -577,6 +689,129 @@ class ZKDatabase(NodeTree):
         self._commit(('set_data', path, node.data, zxid, node.mtime))
         return node.stat()
 
+    def check(self, path: str, version: int) -> None:
+        """The CHECK sub-op (MULTI-only, like real ZK): version guard
+        with no mutation and no log entry."""
+        validate_path(path)
+        node = self.nodes.get(path)
+        if node is None:
+            raise ZKOpError('NO_NODE')
+        if version >= 0 and version != node.version:
+            raise ZKOpError('BAD_VERSION')
+
+    # -- MULTI: one all-or-nothing transaction ------------------------
+
+    def multi(self, ops: list, session: ZKServerSession | None = None
+              ) -> list:
+        """Apply ``ops`` (sub-op dicts: create / delete / set_data /
+        check) as ONE transaction: all of them commit as a single
+        ('multi', subs) log entry — one WAL record, one group-fsync
+        slot, one replication push element — or none of them touch
+        the tree at all.
+
+        The apply is speculative-with-undo rather than
+        validate-then-apply: each sub-op runs through the exact
+        single-op path (so validation can never diverge from it) with
+        change events buffered and commits intercepted; the first
+        failure rolls the applied prefix back — pre-copied nodes and
+        parents restored in reverse order, zxid rewound, buffered
+        events dropped — and every position reports an error result
+        (the failing op its real code, the rest
+        RUNTIME_INCONSISTENCY, real ZK's multi error shape).  On
+        success the buffered events fire in apply order."""
+        if not ops:
+            return []
+        start_zxid = self.zxid
+        buf: list[tuple] = []
+        events: list = []
+        undo: list = []
+        results: list = []
+        failure: tuple[int, str] | None = None
+        self._multi_buf = buf
+        self._event_buf = events
+        try:
+            for op in ops:
+                name = op.get('op')
+                path = op.get('path', '')
+                saved = (_copy_znode(self.nodes.get(path)),
+                         _copy_znode(self.nodes.get(
+                             parent_path(path) if path else '/')))
+                n_before = len(buf)
+                try:
+                    if name == 'create':
+                        made = self.create(
+                            path, op.get('data', b''), op.get('acl'),
+                            CreateFlag(op.get('flags', 0)), session)
+                        results.append({'op': 'create', 'path': made})
+                    elif name == 'delete':
+                        self.delete(path, op.get('version', -1))
+                        results.append({'op': 'delete'})
+                    elif name == 'set_data':
+                        stat = self.set_data(path, op['data'],
+                                             op.get('version', -1))
+                        results.append({'op': 'set_data',
+                                        'stat': stat})
+                    elif name == 'check':
+                        self.check(path, op.get('version', -1))
+                        results.append({'op': 'check'})
+                    else:
+                        raise ZKOpError('BAD_ARGUMENTS')
+                except ZKOpError as e:
+                    failure = (len(results), e.code)
+                    break
+                if len(buf) > n_before:
+                    undo.append((buf[-1], saved))
+        finally:
+            self._multi_buf = None
+            self._event_buf = None
+        if failure is not None:
+            self._rollback_multi(undo, start_zxid)
+            idx, code = failure
+            return [{'op': 'error',
+                     'err': code if i == idx
+                     else 'RUNTIME_INCONSISTENCY'}
+                    for i in range(len(ops))]
+        if buf:
+            self.multi_batches += 1
+            self.multi_subops += len(buf)
+            self._commit(('multi', tuple(buf)))
+            for ev, args in events:
+                self.emit(ev, *args)
+        return results
+
+    def _rollback_multi(self, undo: list, start_zxid: int) -> None:
+        """Reverse an applied MULTI prefix: each step restores the
+        node/parent copies captured just before its sub-op, newest
+        first, then the zxid rewinds — byte-identical to never having
+        applied (no event fired, nothing logged)."""
+        for entry, (node_copy, parent_copy) in reversed(undo):
+            op = entry[0]
+            path = entry[1]
+            ppath = parent_path(path)
+            if op == 'create':
+                self.nodes.pop(path, None)
+                if parent_copy is not None:
+                    self.nodes[ppath] = parent_copy
+                if entry[4]:
+                    sess = self.sessions.get(entry[4])
+                    if sess is not None:
+                        sess.ephemerals.discard(path)
+            elif op == 'delete':
+                if node_copy is not None:
+                    self.nodes[path] = node_copy
+                    if node_copy.ephemeral_owner:
+                        sess = self.sessions.get(
+                            node_copy.ephemeral_owner)
+                        if sess is not None:
+                            sess.ephemerals.add(path)
+                if parent_copy is not None:
+                    self.nodes[ppath] = parent_copy
+            else:
+                assert op == 'set_data', op
+                if node_copy is not None:
+                    self.nodes[path] = node_copy
+        self.zxid = start_zxid
+
 
 class ReplicaStore(NodeTree):
     """One follower's local view of the tree, fed by the leader's
@@ -640,6 +875,13 @@ class ReplicaStore(NodeTree):
         snapshot stamps (server/persist.py format 2)."""
         return getattr(self.leader, 'epoch', 0)
 
+    def session_snapshot(self) -> dict:
+        """The session table a mirror WAL snapshot stamps (format 3):
+        the leader handle's — the shared database in process, the
+        replicated mirror table cross-process — in durable form."""
+        sessions = getattr(self.leader, 'sessions', None)
+        return durable_sessions(sessions) if sessions else {}
+
     def _on_commit(self) -> None:
         if self.lag is None:
             return
@@ -661,12 +903,24 @@ class ReplicaStore(NodeTree):
                 self._apply_one(ldr.log[self.applied - ldr.log_base])
                 self.applied += 1
 
+    #: Optional quorum-commit ack hook (server/replication.py
+    #: QuorumGate): called with this replica's zxid after every
+    #: applied entry — the in-process ensemble's piggybacked
+    #: applied-zxid vote.  Class-level None keeps the no-quorum hot
+    #: path a single attribute test.
+    on_applied = None
+
     def _apply_one(self, entry: tuple) -> None:
         self.apply_entry(entry)
         if self.trace is not None:
-            self.trace.note('APPLY', entry[1],
+            self.trace.note('APPLY',
+                            entry[1] if isinstance(entry[1], str)
+                            else None,
                             zxid=entry_zxid(entry), kind='server',
                             detail=entry[0])
+        cb = self.on_applied
+        if cb is not None:
+            cb(self.zxid)
 
     def catch_up(self) -> None:
         """Apply everything committed so far — what a write through

@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 6
+_EXT_ABI_VERSION = 7
 
 _ext = None
 _ext_load_failed = False
@@ -219,6 +219,14 @@ _EXT_REQ_LAYOUTS = {
     'CREATE': 3, 'DELETE': 4, 'GET_ACL': 1, 'SET_DATA': 5, 'SYNC': 1,
     'SET_WATCHES': 6, 'CLOSE_SESSION': 0, 'PING': 0,
 }
+
+#: Opcodes the spec tier decodes but the extension deliberately PUNTS
+#: (decode_stream returns kind='UNSUPPORTED' at the frame boundary and
+#: PacketCodec hands the rest of the buffer to the Python spec tier).
+#: MULTI's variable-shape header/body framing is batch-rare and not
+#: worth a C layout; the sync test in tests/test_native_ext.py holds
+#: ``layouts | punts == spec readers``.
+_EXT_PUNT_OPS = frozenset(('MULTI',))
 
 
 def ext_setup_args() -> tuple:
