@@ -9,7 +9,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 .PHONY: all test check analyze native bench asan ubsan sanitize \
     chaos chaos-ensemble obs durability election bench-wal \
     bench-fanout bench-trace bench-election bench-transport \
-    bench-quorum timeline coverage clean
+    bench-ingress bench-quorum timeline coverage clean
 
 all: check test
 
@@ -104,6 +104,18 @@ bench-wal:
 # ZKSTREAM_BENCH_TRANSPORT_ROUNDS; narrow with --conns/--workloads.
 bench-transport: native
 	$(PYTHON) bench.py --transport
+
+# Shared-nothing ingress envelope: per-core accept shards + batched
+# receive drain (io/ingress.py) vs the single-loop validator, paired
+# cells over real kernel sockets at 1k/10k/100k connections x
+# write-heavy/fanout with exact sign tests, syscalls-per-tick
+# accounted BOTH directions per cell
+# (zookeeper_flush_syscalls_total + zookeeper_recv_syscalls_total /
+# zookeeper_recv_drain_depth) and tick-ledger phase shares incl. the
+# new rx_drain phase (table in PROFILE.md "Ingress").  Rounds via
+# ZKSTREAM_BENCH_INGRESS_ROUNDS; narrow with --conns/--workloads.
+bench-ingress: native
+	$(PYTHON) bench.py --ingress
 
 # Serving-plane fan-out envelope: the sharded watch table vs the
 # per-connection emitter dispatch (server/watchtable.py), paired

@@ -1756,11 +1756,20 @@ def _transport_fd_clamp(conns: int) -> int:
 
 
 async def transport_cell(conns: int, workload: str, backend: str,
-                         collector=None, events: int | None = None
+                         collector=None, events: int | None = None,
+                         ingress_shards: int | None = None,
+                         ingress_backend: str | None = None,
+                         time_arms: bool = False
                          ) -> dict:
     """One transport-tier measurement over REAL kernel sockets:
     ``conns`` raw TCP connections into one server, each holding a
     session.
+
+    ``ingress_shards`` / ``ingress_backend`` parameterize the server's
+    receive path (io/ingress.py) — ``bench.py --ingress`` pairs the
+    sharded batched drain against the single-loop validator through
+    this same cell, with the transport backend held at the process
+    default for both arms so the delta isolates the rx direction.
 
     ``workload='write'``: per event every connection sends one
     pipelined EXISTS and the cell times the all-requests ->
@@ -1773,7 +1782,13 @@ async def transport_cell(conns: int, workload: str, backend: str,
     ``backend`` forces the tier ('uring' | 'mmsg' | 'asyncio' — the
     paired A/B arms); the cell scrapes
     ``zookeeper_flush_syscalls_total`` and ``zookeeper_submit_depth``
-    so the syscalls-per-tick claim is measured, not asserted."""
+    so the syscalls-per-tick claim is measured, not asserted.
+
+    ``time_arms`` moves the fanout workload's watcher re-arm burst
+    INSIDE the timed window (the ingress pairing sets it: the
+    all-watchers pipelined GET_DATA+watch burst is the cell's
+    receive-heavy leg — the transport pairing keeps the legacy
+    notify-only window, which contains almost no rx work)."""
     import asyncio
     import selectors
     import socket
@@ -1784,10 +1799,14 @@ async def transport_cell(conns: int, workload: str, backend: str,
         METRIC_SUBMIT_DEPTH
 
     loop = asyncio.get_running_loop()
-    srv = await ZKServer(transport=backend, collector=collector
-                         ).start()
+    srv = await ZKServer(transport=backend, collector=collector,
+                         ingress_shards=ingress_shards,
+                         ingress_backend=ingress_backend).start()
     resolved = ('asyncio' if srv.transport_tier is None
                 else srv.transport_tier.backend)
+    resolved_ingress = ('asyncio' if srv.ingress is None
+                        else srv.ingress.backend)
+    resolved_shards = 1 if srv.ingress is None else srv.ingress.nshards
     socks: list = []
     codecs: list = []
     inbox: dict[int, list] = {}
@@ -1961,11 +1980,14 @@ async def transport_cell(conns: int, workload: str, backend: str,
             fan_targets = {w: notif_len for w in watchers}
             fan_targets[0] = set_len
             for ev in range(events):
+                if time_arms:
+                    t0 = loop.time()
                 await send_all(req({'opcode': 'GET_DATA',
                                     'path': '/hot', 'watch': True}),
                                idxs=watchers)
                 await recv_bytes({w: arm_len for w in watchers})
-                t0 = loop.time()
+                if not time_arms:
+                    t0 = loop.time()
                 await send_all(req({'opcode': 'SET_DATA',
                                     'path': '/hot',
                                     'data': b'z' * 64,
@@ -1986,6 +2008,8 @@ async def transport_cell(conns: int, workload: str, backend: str,
     p50, p99 = _percentiles(lat_ms)
     out = {'conns': conns, 'workload': workload,
            'backend': backend, 'resolved_backend': resolved,
+           'ingress_backend': resolved_ingress,
+           'ingress_shards': resolved_shards,
            'events': events,
            'event_ms_mean': round(sum(lat_ms) / len(lat_ms), 3),
            'event_ms_p50': round(p50, 3),
@@ -2018,6 +2042,11 @@ async def transport_cell(conns: int, workload: str, backend: str,
                     'submissions': n,
                     'mean': round(dep.sum(labels) / n, 1),
                     'p99': round(dep.percentile(99, labels), 1)}
+        # the rx direction: receive submissions by backend + drain
+        # depth (io/ingress.py) — syscalls-per-tick accounted BOTH
+        # ways per cell
+        from zkstream_tpu.io.ingress import scrape_recv_cells
+        out.update(scrape_recv_cells(collector))
         from zkstream_tpu.utils.metrics import scrape_tick_cells
         tick = scrape_tick_cells(collector)
         if tick:
@@ -2106,6 +2135,130 @@ def bench_transport() -> None:
                 'conns': conns,
                 'workload': wl,
                 'backend': batched,
+                'rounds': len(paired),
+                'wins': wins,
+                'losses': losses,
+                'mean_delta_pct': round(sum(deltas)
+                                        / max(1, len(deltas)), 1),
+                'sign_p': round(sign_test_p(wins, losses), 4),
+            }), flush=True)
+
+
+#: `bench.py --ingress` sweep (the shared-nothing ingress cell
+#: family): connections x workload, multi-shard batched drain vs the
+#: single-loop validator.  Real kernel sockets (the thing measured IS
+#: the receive path); the 10k/100k cells clamp to the fd limit.
+INGRESS_SCALES = (1000, 10000, 100000)
+INGRESS_WORKLOADS = ('write', 'fanout')
+
+
+def bench_ingress() -> None:
+    """The shared-nothing ingress envelope (`make bench-ingress`):
+    paired multi-shard vs single-loop cells over the conns x workload
+    sweep (1k/10k/100k x write-heavy/fanout), per-round adjacent A/B
+    runs, exact two-sided sign test on the per-event latency — the
+    PROFILE.md methodology, same as the cork/WAL/fan-out/transport
+    families.  Syscalls-per-tick are printed per cell in BOTH
+    directions: tx from ``zookeeper_flush_syscalls_total``, rx from
+    ``zookeeper_recv_syscalls_total`` + ``zookeeper_recv_drain_depth``
+    (drain submissions are O(dirty shards) per tick on the batched
+    tier; the per-fd recv count inside the one C call stays O(dirty
+    conns) until the uring arm — re-measured on a >= 5.1 kernel).
+    Both arms run the same transport backend (the process default) so
+    the delta isolates the receive direction.  Scale with
+    ZKSTREAM_BENCH_INGRESS_ROUNDS; narrow with ``--conns`` /
+    ``--workloads`` comma-lists."""
+    import asyncio
+
+    from zkstream_tpu.io.ingress import probe, shards_default
+    from zkstream_tpu.utils.metrics import Collector, sign_test_p
+
+    p = probe()
+    batched = 'uring' if p.uring else ('mmsg' if p.mmsg else None)
+    if batched is None:
+        print('# no batched ingress backend available on this '
+              'platform (uring: %s; mmsg: %s) — nothing to pair'
+              % (p.uring_reason, p.mmsg_reason), file=sys.stderr)
+        return
+    shards = shards_default()
+    if shards < 2:
+        shards = 2      # a 1-core box still pairs sharded vs single
+    print('# ingress probe: %s (pairing %d-shard %s vs single-loop)'
+          % (p, shards, batched), file=sys.stderr)
+    conns_sweep = _arg_ints('--conns') or list(INGRESS_SCALES)
+    workloads = INGRESS_WORKLOADS
+    if '--workloads' in sys.argv:
+        idx = sys.argv.index('--workloads')
+        if idx + 1 < len(sys.argv):
+            workloads = tuple(w for w in sys.argv[idx + 1].split(',')
+                              if w)
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_INGRESS_ROUNDS',
+                                '10'))
+    # both arms ride the SAME (default) transport backend: the A/B
+    # delta must isolate the receive direction
+    from zkstream_tpu.io.transport import backend_default
+    txb = backend_default()
+    #: (arm label) -> (ingress_shards, ingress_backend) cell args
+    arms = {'sharded': (shards, batched), 'single': (1, 'asyncio')}
+    rows: dict = {}
+    cells: dict = {}
+    for rnd in range(rounds):
+        #: (clamped width, workload) -> measured pair: two nominal
+        #: scales clamping to the SAME width (10k and 100k on a 20k
+        #: fd limit) are one measurement, not two — the duplicate
+        #: row reuses it instead of burning a full re-run per round
+        measured: dict = {}
+        for conns in conns_sweep:
+            clamped = _transport_fd_clamp(conns)
+            if clamped < conns and rnd == 0:
+                print('# ingress cell %d clamped to %d conns '
+                      '(fd limit)' % (conns, clamped),
+                      file=sys.stderr)
+            for wl in workloads:
+                pair = measured.get((clamped, wl))
+                if pair is None:
+                    pair = {}
+                    for arm, (ns, ib) in arms.items():
+                        col = Collector()
+                        try:
+                            pair[arm] = asyncio.run(transport_cell(
+                                clamped, wl, txb,
+                                collector=col, ingress_shards=ns,
+                                ingress_backend=ib, time_arms=True))
+                        except Exception as e:
+                            print('# ingress cell %dx%s %s round '
+                                  'failed: %r'
+                                  % (clamped, wl, arm, e),
+                                  file=sys.stderr)
+                    measured[(clamped, wl)] = pair
+                for arm, r in pair.items():
+                    key = (conns, wl, arm)
+                    if len(pair) == 2:
+                        rows.setdefault(key, []).append(
+                            r['event_ms_mean'])
+                    if key not in cells or r['event_ms_mean'] < \
+                            cells[key]['event_ms_mean']:
+                        cells[key] = dict(r, arm=arm)
+    for key in sorted(cells, key=str):
+        print('# ingress_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for conns in conns_sweep:
+        for wl in workloads:
+            a = rows.get((conns, wl, 'sharded'), [])
+            b = rows.get((conns, wl, 'single'), [])
+            if not a or not b:
+                continue
+            paired = list(zip(a, b))
+            # positive delta = sharded faster (lower latency)
+            deltas = [(y - x) / y * 100.0 for x, y in paired if y]
+            wins = sum(1 for x, y in paired if x < y)
+            losses = sum(1 for x, y in paired if x > y)
+            print(json.dumps({
+                'metric': 'ingress_shards_sign_test',
+                'conns': conns,
+                'workload': wl,
+                'shards': shards,
+                'ingress_backend': batched,
                 'rounds': len(paired),
                 'wins': wins,
                 'losses': losses,
@@ -2219,6 +2372,15 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_transport()
+        return
+    if '--ingress' in sys.argv:
+        # `make bench-ingress`: the shared-nothing ingress cell
+        # family (io/ingress.py: multi-shard batched receive drain
+        # vs the single-loop validator) over real kernel sockets.
+        # Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_ingress()
         return
     if '--fanout' in sys.argv:
         # `make bench-fanout`: the serving-plane fan-out cell family

@@ -1120,7 +1120,7 @@ static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
 }
 
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(7);
+  return PyLong_FromLong(8);
 }
 
 /* CRC32C (Castagnoli, reflected 0x82F63B78) for the write-ahead-log
@@ -1362,6 +1362,77 @@ fail:
   return NULL;
 }
 
+/* ---- batched receive drain (io/ingress.py) --------------------------
+ *
+ * The receive-direction twin of submit_writev: one C call per dirty
+ * ingress shard per tick takes the shard's readable fds and moves
+ * every connection's pending bytes out of the kernel — one recv(2)
+ * per fd inside the call (TCP has no cross-fd recvmmsg; the Python-
+ * level submission count is what drops to O(dirty shards)), zero
+ * per-fd Python dispatch, zero intermediate buffers.
+ *
+ *   drain_recv(fds, bufsize)
+ *     -> [bytes | -errno, ...]   per fd: the received bytes (b'' =
+ *        EOF, exactly what a StreamReader read returns at EOF), or
+ *        a negative errno (-EAGAIN = readiness raced an earlier
+ *        drain; the caller skips, never closes).
+ *
+ * Buffers are allocated at bufsize and resized down to the received
+ * length — the common short read costs one shrink, never a copy of
+ * bytes that were not received. */
+
+static PyObject *py_drain_recv(PyObject *self, PyObject *args) {
+  PyObject *fds_obj;
+  int bufsize;
+  if (!PyArg_ParseTuple(args, "Oi", &fds_obj, &bufsize)) return NULL;
+  if (bufsize <= 0) {
+    PyErr_SetString(PyExc_ValueError, "bufsize must be positive");
+    return NULL;
+  }
+  PyObject *fast = PySequence_Fast(fds_obj, "fds must be a sequence");
+  if (!fast) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject *results = PyList_New(n);
+  if (!results) {
+    Py_DECREF(fast);
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    long fd = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, i));
+    if (fd == -1 && PyErr_Occurred()) goto fail;
+    PyObject *buf = PyBytes_FromStringAndSize(NULL, bufsize);
+    if (!buf) goto fail;
+    ssize_t r;
+    do {
+      r = recv((int)fd, PyBytes_AS_STRING(buf), (size_t)bufsize,
+               MSG_DONTWAIT);
+    } while (r < 0 && errno == EINTR);
+    if (r < 0 && errno == ENOTSOCK) {
+      /* non-socket fd (test double over a pipe): plain read — the
+       * caller's fds are already non-blocking */
+      do {
+        r = read((int)fd, PyBytes_AS_STRING(buf), (size_t)bufsize);
+      } while (r < 0 && errno == EINTR);
+    }
+    if (r < 0) {
+      Py_DECREF(buf);
+      PyObject *val = PyLong_FromLong(-(long)errno);
+      if (!val) goto fail;
+      PyList_SET_ITEM(results, i, val);
+      continue;
+    }
+    if (r < (ssize_t)bufsize && _PyBytes_Resize(&buf, r) < 0)
+      goto fail;
+    PyList_SET_ITEM(results, i, buf);
+  }
+  Py_DECREF(fast);
+  return results;
+fail:
+  Py_DECREF(fast);
+  Py_DECREF(results);
+  return NULL;
+}
+
 #ifdef __linux__
 
 /* io_uring ABI, declared locally: this image's kernel headers may
@@ -1572,6 +1643,10 @@ static PyObject *py_uring_submit(PyObject *self, PyObject *args) {
       return PyErr_NoMemory();
     }
     int bad = 0;
+    int inflight = 0; /* wait-phase enter failure: submitted sends may
+                       * still run — the kernel reads their iovecs and
+                       * buffers, so the unreaped entries' resources
+                       * must be LEAKED, never released */
     u->gen++;
     unsigned tail = *u->sq_tail;
     for (Py_ssize_t k = 0; k < wave; k++) {
@@ -1617,13 +1692,15 @@ static PyObject *py_uring_submit(PyObject *self, PyObject *args) {
                       ZK_IORING_ENTER_GETEVENTS, NULL, 0);
         } while (r < 0 && errno == EINTR);
         enters++;
-        if (r < 0)
+        if (r < 0) {
           /* a failed SUBMIT enter consumed no SQEs — the caller may
            * safely resend those entries elsewhere; a failed WAIT
            * enter leaves already-submitted sends in flight, so the
            * unfilled slots report EIO ("state unknown": resending
            * could duplicate bytes, the caller must drop) */
           failed_errno = submit_phase ? errno : EIO;
+          if (!submit_phase) inflight = 1;
+        }
         to_submit = 0;
         /* reap whatever is available — after an enter failure this is
          * the best-effort pass that keeps real completions (and
@@ -1653,15 +1730,19 @@ static PyObject *py_uring_submit(PyObject *self, PyObject *args) {
             if (filled[k]) continue;
             PyObject *val = PyLong_FromLongLong(e);
             if (val) PyList_SET_ITEM(results, done + k, val);
-            filled[k] = 1;
+            filled[k] = 2; /* errno-filled: possibly still in flight */
           }
           break;
         }
       }
     }
     for (Py_ssize_t k = 0; k < wave; k++)
-      if (fastv[k]) release_iov(bufsv[k], iovv[k], fastv[k], nchv[k]);
-    PyMem_Free(msgs);
+      /* an inflight wave's unreaped entries stay kernel-readable:
+       * leak their buffer views (and msgs below) rather than hand
+       * the kernel freed memory to send from */
+      if (fastv[k] && !(inflight && filled[k] == 2))
+        release_iov(bufsv[k], iovv[k], fastv[k], nchv[k]);
+    if (!inflight) PyMem_Free(msgs);
     PyMem_Free(bufsv);
     PyMem_Free(iovv);
     PyMem_Free(fastv);
@@ -1677,6 +1758,177 @@ static PyObject *py_uring_submit(PyObject *self, PyObject *args) {
   }
   Py_DECREF(fast);
   Py_DECREF(clfast);
+  return Py_BuildValue("(Nl)", results, enters);
+}
+
+/* Batched receive through the ring (io/ingress.py uring arm): one
+ * RECVMSG SQE per dirty connection, ONE enter submits and reaps the
+ * wave — O(1) syscalls per drain regardless of the dirty-set width.
+ * RECVMSG is the stable v5.1 ABI like the send side's SENDMSG; the
+ * multishot upgrade (IORING_RECV_MULTISHOT, >= 5.19/6.0 kernels:
+ * one standing SQE per connection, completions without resubmission)
+ * is declared below and carried until a kernel that has it can
+ * measure it — this image's 4.4 kernel gates the whole arm off at
+ * probe time anyway. */
+
+#define ZK_IORING_OP_RECVMSG 10
+#define ZK_IORING_RECV_MULTISHOT (1u << 1) /* sqe->ioprio flag */
+
+static PyObject *py_uring_recv(PyObject *self, PyObject *args) {
+  PyObject *cap, *fds_obj;
+  int bufsize;
+  if (!PyArg_ParseTuple(args, "OOi", &cap, &fds_obj, &bufsize))
+    return NULL;
+  if (bufsize <= 0) {
+    PyErr_SetString(PyExc_ValueError, "bufsize must be positive");
+    return NULL;
+  }
+  zk_uring *u = uring_from_capsule(cap);
+  if (!u) return NULL;
+  PyObject *fast = PySequence_Fast(fds_obj, "fds must be a sequence");
+  if (!fast) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject *results = PyList_New(n);
+  if (!results) {
+    Py_DECREF(fast);
+    return NULL;
+  }
+  long enters = 0;
+  Py_ssize_t done = 0;
+  while (done < n) {
+    Py_ssize_t wave = n - done;
+    if (wave > (Py_ssize_t)u->sq_entries) wave = u->sq_entries;
+    struct msghdr *msgs = PyMem_Calloc(wave, sizeof(struct msghdr));
+    struct iovec *iov = PyMem_Calloc(wave, sizeof(struct iovec));
+    PyObject **bufv = PyMem_Calloc(wave, sizeof(PyObject *));
+    char *filled = PyMem_Calloc(wave, 1);
+    if (!msgs || !iov || !bufv || !filled) {
+      PyMem_Free(msgs);
+      PyMem_Free(iov);
+      PyMem_Free(bufv);
+      PyMem_Free(filled);
+      Py_DECREF(fast);
+      Py_DECREF(results);
+      return PyErr_NoMemory();
+    }
+    int bad = 0;
+    int inflight = 0; /* wait-phase enter failure: submitted recvs may
+                       * still complete — their buffers (and the
+                       * msghdr/iovec the SQEs point at) belong to the
+                       * kernel now and must be LEAKED, never freed,
+                       * or a late completion DMA-writes freed heap */
+    u->gen++;
+    unsigned tail = *u->sq_tail;
+    for (Py_ssize_t k = 0; k < wave; k++) {
+      long fd = PyLong_AsLong(PySequence_Fast_GET_ITEM(fast, done + k));
+      if (fd == -1 && PyErr_Occurred()) {
+        bad = 1;
+        break;
+      }
+      bufv[k] = PyBytes_FromStringAndSize(NULL, bufsize);
+      if (!bufv[k]) {
+        bad = 1;
+        break;
+      }
+      iov[k].iov_base = PyBytes_AS_STRING(bufv[k]);
+      iov[k].iov_len = (size_t)bufsize;
+      msgs[k].msg_iov = &iov[k];
+      msgs[k].msg_iovlen = 1;
+      unsigned slot = tail & *u->sq_mask;
+      struct zk_sqe *sqe = &u->sqes[slot];
+      memset(sqe, 0, sizeof(*sqe));
+      sqe->opcode = ZK_IORING_OP_RECVMSG;
+      sqe->fd = (int)fd;
+      sqe->addr = (uint64_t)(uintptr_t)&msgs[k];
+      sqe->len = 1;
+      sqe->msg_flags = MSG_DONTWAIT;
+      sqe->user_data = (u->gen << 20) | (uint64_t)k;
+      u->sq_array[slot] = slot;
+      tail++;
+    }
+    if (!bad) {
+      __atomic_store_n(u->sq_tail, tail, __ATOMIC_RELEASE);
+      Py_ssize_t reaped = 0;
+      unsigned to_submit = (unsigned)wave;
+      int failed_errno = 0;
+      while (reaped < wave) {
+        int submit_phase = to_submit != 0;
+        long r;
+        do {
+          r = syscall(__NR_io_uring_enter, u->ring_fd, to_submit,
+                      (unsigned)(wave - reaped),
+                      ZK_IORING_ENTER_GETEVENTS, NULL, 0);
+        } while (r < 0 && errno == EINTR);
+        enters++;
+        if (r < 0) {
+          /* same contract as uring_submit: a failed SUBMIT enter
+           * consumed no SQEs (the caller may retry elsewhere); a
+           * failed WAIT enter leaves recvs possibly in flight, so
+           * unfilled slots report EIO — their buffers were handed to
+           * the kernel and must not be reused */
+          failed_errno = submit_phase ? errno : EIO;
+          if (!submit_phase) inflight = 1;
+        }
+        to_submit = 0;
+        unsigned head = __atomic_load_n(u->cq_head, __ATOMIC_ACQUIRE);
+        unsigned ctail = __atomic_load_n(u->cq_tail, __ATOMIC_ACQUIRE);
+        while (head != ctail) {
+          struct zk_cqe *cqe = &u->cqarr[head & *u->cq_mask];
+          head++;
+          if ((cqe->user_data >> 20) != u->gen)
+            continue; /* stale generation: consume and ignore */
+          Py_ssize_t k = (Py_ssize_t)(cqe->user_data & 0xFFFFF);
+          if (k >= 0 && k < wave && !filled[k]) {
+            PyObject *val;
+            if (cqe->res < 0) {
+              val = PyLong_FromLong((long)cqe->res);
+              Py_CLEAR(bufv[k]);
+            } else {
+              val = bufv[k];
+              bufv[k] = NULL;
+              if (cqe->res < bufsize &&
+                  _PyBytes_Resize(&val, cqe->res) < 0) {
+                PyErr_Clear();
+                val = PyLong_FromLong(-(long)ENOMEM);
+              }
+            }
+            if (val) PyList_SET_ITEM(results, done + k, val);
+            filled[k] = 1;
+            reaped++;
+          }
+        }
+        __atomic_store_n(u->cq_head, head, __ATOMIC_RELEASE);
+        if (failed_errno) {
+          long e = -(long)failed_errno;
+          for (Py_ssize_t k = 0; k < wave; k++) {
+            if (filled[k]) continue;
+            PyObject *val = PyLong_FromLong(e);
+            if (val) PyList_SET_ITEM(results, done + k, val);
+            filled[k] = 1;
+          }
+          break;
+        }
+      }
+    }
+    if (!inflight) {
+      /* normal wave: every CQE reaped (or nothing was submitted) —
+       * slots still in bufv are ours to drop */
+      for (Py_ssize_t k = 0; k < wave; k++) Py_XDECREF(bufv[k]);
+      PyMem_Free(msgs);
+      PyMem_Free(iov);
+    }
+    /* inflight: leak bufv[k] objects + msgs/iov (kernel-owned); the
+     * bookkeeping arrays below were never handed to the kernel */
+    PyMem_Free(bufv);
+    PyMem_Free(filled);
+    if (bad) {
+      Py_DECREF(fast);
+      Py_DECREF(results);
+      return NULL;
+    }
+    done += wave;
+  }
+  Py_DECREF(fast);
   return Py_BuildValue("(Nl)", results, enters);
 }
 
@@ -1702,6 +1954,7 @@ static PyObject *py_uring_unsupported(PyObject *self, PyObject *args) {
 }
 #define py_uring_create py_uring_unsupported
 #define py_uring_submit py_uring_unsupported
+#define py_uring_recv py_uring_unsupported
 #define py_uring_close py_uring_unsupported
 
 #endif /* __linux__ */
@@ -1734,6 +1987,13 @@ static PyMethodDef methods[] = {
      "uring_submit(ring, fds, chunklists) -> "
      "([sent|-errno, ...], enter_syscalls) — one chained submission "
      "covering the whole batch"},
+    {"drain_recv", py_drain_recv, METH_VARARGS,
+     "drain_recv(fds, bufsize) -> [bytes|-errno, ...] — one receive "
+     "per fd in ONE C call (b'' = EOF; -EAGAIN = nothing pending)"},
+    {"uring_recv", py_uring_recv, METH_VARARGS,
+     "uring_recv(ring, fds, bufsize) -> "
+     "([bytes|-errno, ...], enter_syscalls) — one chained RECVMSG "
+     "submission covering the whole dirty set"},
     {"uring_close", py_uring_close, METH_VARARGS,
      "uring_close(ring) — unmap and close the ring fd"},
     {"abi_version", py_abi_version, METH_NOARGS, "native ABI version"},

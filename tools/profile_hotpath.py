@@ -28,8 +28,20 @@ reply (server direction) and the SET_DATA request (client direction):
              encoders
   ext        the C encoders in native/zkwire_ext.c, when buildable
 
+Ingress (``--ingress``): the receive-drain micro-profile, per
+PROFILE.md "Ingress".  N socketpairs all holding pending bytes, three
+ways to move them out of the kernel:
+
+  stream     per-connection asyncio StreamReader reads — one task
+             wakeup + read() per connection (the single-loop
+             validator's shape)
+  os.read    flat per-fd os.read loop in Python (the batch tier's
+             pure-Python fallback)
+  drain_recv the whole dirty set in ONE C call
+             (native/zkwire_ext.c), when buildable
+
 Usage:  python tools/profile_hotpath.py [--frames N] [--reps N]
-                                        [--encode]
+                                        [--encode | --ingress]
 """
 
 from __future__ import annotations
@@ -154,16 +166,115 @@ def run_encode_profile(frames: int, reps: int) -> None:
                   % (name, mibs, us))
 
 
+def run_ingress_profile(conns: int, reps: int,
+                        payload: int = 512) -> None:
+    """The receive-drain A/B: ``conns`` dirty sockets, every tier
+    must surface the same bytes — per-connection stream reads vs the
+    flat ``os.read`` loop vs the one-C-call batch drain."""
+    import asyncio
+    import os
+    import socket
+
+    pairs = [socket.socketpair() for _ in range(conns)]
+    for a, b in pairs:
+        a.setblocking(False)
+        b.setblocking(False)
+    fds = [a.fileno() for a, _b in pairs]
+    blob = b'x' * payload
+
+    def fill() -> None:
+        for _a, b in pairs:
+            b.send(blob)
+
+    def t_osread() -> int:
+        total = 0
+        for fd in fds:
+            total += len(os.read(fd, 65536))
+        return total
+
+    ext = native.ensure_ext()
+
+    def t_drain() -> int:
+        return sum(len(r) for r in ext.drain_recv(fds, 65536))
+
+    tiers = [('os.read loop (python)', t_osread)]
+    if ext is not None:
+        tiers.append(('drain_recv (C, one call)', t_drain))
+    else:
+        print('C extension unavailable; skipping drain_recv tier')
+    print('%d dirty connections, %d B pending each:'
+          % (conns, payload))
+    for name, fn in tiers:
+        best = float('inf')
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fill()
+                n = fn()
+                assert n == conns * payload
+            best = min(best, (time.perf_counter() - t0) / reps)
+        print('  %-26s %8.1f us/drain  (%.3f us/conn)'
+              % (name, best * 1e6, best * 1e6 / conns))
+    # the stream tier: one pending task wakeup per connection — the
+    # asyncio machinery the sharded drain deletes.  Fresh socketpairs
+    # (the transports above own their fds).
+    spairs = [socket.socketpair() for _ in range(conns)]
+
+    async def stream_round() -> None:
+        loop = asyncio.get_running_loop()
+        readers = []
+        transports = []
+        for a, _b in spairs:
+            a.setblocking(False)
+            reader = asyncio.StreamReader()
+            tr, _p = await loop.connect_accepted_socket(
+                lambda r=reader: asyncio.StreamReaderProtocol(r),
+                sock=a)
+            readers.append(reader)
+            transports.append(tr)
+        best = float('inf')
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for _a, b in spairs:
+                    b.send(blob)
+                got = 0
+                for r in readers:
+                    while True:
+                        got += len(await asyncio.wait_for(
+                            r.read(65536), 5))
+                        if got % payload == 0:
+                            break
+                assert got == conns * payload
+            best = min(best, (time.perf_counter() - t0) / reps)
+        print('  %-26s %8.1f us/drain  (%.3f us/conn)'
+              % ('StreamReader (asyncio)', best * 1e6,
+                 best * 1e6 / conns))
+        for tr in transports:
+            tr.close()
+
+    asyncio.run(stream_round())
+    for a, b in pairs + spairs:
+        a.close()
+        b.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument('--frames', type=int, default=2000)
     ap.add_argument('--reps', type=int, default=20)
     ap.add_argument('--encode', action='store_true',
                     help='profile the encode tiers instead of decode')
+    ap.add_argument('--ingress', action='store_true',
+                    help='profile the receive-drain tiers '
+                         '(io/ingress.py) instead of decode')
     args = ap.parse_args()
 
     if args.encode:
         run_encode_profile(args.frames, args.reps)
+        return
+    if args.ingress:
+        run_ingress_profile(min(args.frames, 512), args.reps)
         return
 
     stream = mk_stream(args.frames)

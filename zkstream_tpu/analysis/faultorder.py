@@ -29,7 +29,10 @@ from .core import Context, Finding, Module, walk_no_funcs
 NAME = 'fault-order'
 
 #: FaultInjector per-frame / per-event hook methods (io/faults.py).
-FAULT_ATTRS = ('tx', 'rx', 'server_tx', 'accept_refuse',
+#: ``server_rx`` is the ingress drain's per-chunk boundary: it must
+#: run before any decode AND before any cork a handler might take
+#: (the receive-side mirror of the tx rule).
+FAULT_ATTRS = ('tx', 'rx', 'server_tx', 'server_rx', 'accept_refuse',
                'drop_push', 'fsync_fault', 'ingest_reset',
                'ingest_cut', 'before_connect',
                'crash_window_before_fsync')

@@ -407,6 +407,15 @@ def build_parser() -> argparse.ArgumentParser:
                          'uring>mmsg>asyncio order, so the rerun '
                          'still executes (the summary names the '
                          'resolved backend)')
+    ch.add_argument('--ingress-shards', type=int, default=None,
+                    dest='ingress_shards', metavar='N',
+                    help='rerun with a forced ingress shard count '
+                         '(io/ingress.py; ZKSTREAM_INGRESS_SHARDS) — '
+                         'part of the rerun key like --transport: '
+                         'N>1 forces the sharded accept + batched '
+                         'receive drain, 1 forces the single-loop '
+                         'validator, so a failing seed bisects to '
+                         'the ingress plane')
     ch.add_argument('--trace-out', metavar='PATH', default=None,
                     help='write every schedule\'s xid-correlated span '
                          'dump — member kill/restart events included '
@@ -484,6 +493,16 @@ async def _chaos(args) -> int:
         from .io.transport import backend_default
         print('# transport backend forced: %s (resolved: %s)'
               % (args.transport, backend_default()))
+    if getattr(args, 'ingress_shards', None):
+        # the schedule servers resolve their receive path from the
+        # env at construction (io/ingress.py); part of the rerun key
+        os.environ['ZKSTREAM_INGRESS_SHARDS'] = \
+            str(args.ingress_shards)
+        from .io.ingress import backend_default as rx_default
+        print('# ingress shards forced: %d (backend: %s)'
+              % (args.ingress_shards,
+                 rx_default() if args.ingress_shards > 1
+                 else 'asyncio'))
 
     def progress(r):
         if args.quiet and r.ok:

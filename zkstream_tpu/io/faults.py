@@ -60,7 +60,7 @@ from .invariants import AMBIGUOUS_CODES
 #: plane (fsync latency/errors, crash-before-fsync vs crash-after-
 #: fsync windows — server/persist.py).
 CATEGORIES = ('connect', 'rx', 'tx', 'accept', 'server_tx',
-              'partition', 'plan', 'ingest', 'disk')
+              'partition', 'plan', 'ingest', 'disk', 'server_rx')
 
 
 class InjectedRefusal(ConnectionRefusedError):
@@ -89,6 +89,13 @@ class FaultConfig:
     p_server_tx_reset: float = 0.0
     p_server_tx_split: float = 0.0
     server_tx_delay_ms: tuple[float, float] = (0.0, 10.0)
+    # server receive path (client -> server bytes AT the server):
+    # injected at the per-frame boundary BEFORE the ingress drain's
+    # decode (io/ingress.py / ServerConnection.feed) — the send
+    # plane's before-the-cork rule mirrored on the rx side
+    p_server_rx_reset: float = 0.0
+    p_server_rx_split: float = 0.0
+    server_rx_delay_ms: tuple[float, float] = (0.0, 8.0)
     # replication: leader -> follower push drop (asymmetric partition)
     p_push_drop: float = 0.0
     # FleetIngest batched drain: tick-time faults (io/ingest.py) — a
@@ -136,6 +143,15 @@ class FaultConfig:
             cfg.fsync_delay_ms = (0.1, drng.uniform(0.5, 4.0))
         if drng.random() < 0.15:
             cfg.p_fsync_error = drng.uniform(0.02, 0.15)
+        # server-rx faults likewise ride their own stream (added with
+        # the ingress plane, PR 13): existing streams' draws are
+        # untouched, the new fault class just joins the mix
+        rrng = random.Random('cfg-srx/%d' % (seed,))
+        if rrng.random() < 0.35:
+            cfg.p_server_rx_split = rrng.uniform(0.02, 0.4)
+            cfg.server_rx_delay_ms = (0.1, rrng.uniform(0.5, 6.0))
+        if rrng.random() < 0.1:
+            cfg.p_server_rx_reset = rrng.uniform(0.01, 0.08)
         return cfg
 
     @classmethod
@@ -433,6 +449,70 @@ class FaultInjector:
             if len(data) > 1 else 0
         lo, hi = cfg.server_tx_delay_ms
         delay = self._streams['server_tx'].uniform(lo, hi)
+        if cut:
+            gate.push(data[:cut])
+            gate.push(data[cut:], delay)
+        else:
+            gate.push(data, delay)
+        return True
+
+    def server_rx(self, server_conn, data: bytes) -> bool:
+        """Server-side receive hook.  Returns True when the injector
+        took over delivery (split/delay/reset), False for
+        pass-through.
+
+        Called per connection-chunk BEFORE any decode — by
+        ``ServerConnection.feed`` on BOTH receive paths (the
+        single-loop validator's read loop and the ingress plane's
+        batched drain, io/ingress.py), so injection stays a per-frame
+        boundary ahead of the batch: a faulted chunk perturbs one
+        connection's stream without reordering it, whichever backend
+        drained the bytes.  Delayed segments re-enter through
+        ``_feed`` (the injector-free half), never through ``feed`` —
+        a faulted chunk is screened exactly once."""
+        cfg = self.config
+        wants_reset = self._take('server_rx', cfg.p_server_rx_reset,
+                                 'server rx mid-frame reset')
+        wants_split = self._take('server_rx', cfg.p_server_rx_split,
+                                 'server rx split/delay')
+        gate = getattr(server_conn, '_fault_srx_gate', None)
+        if not (wants_reset or wants_split):
+            if gate is None or gate.dead or not gate.pending:
+                return False
+            # a delayed segment from an earlier chunk is still in the
+            # gate: this (un-faulted) chunk must queue behind it, or
+            # the server would decode a reordering TCP never delivers
+            gate.push(data)
+            return True
+        if gate is None or gate.dead:
+            def sink(d, c=server_conn):
+                if not c.closed and not c._feed(d):
+                    c.close()
+
+            def on_reset(c=server_conn):
+                try:
+                    t = c.writer.transport
+                    if t is not None:
+                        t.abort()
+                except (ConnectionError, RuntimeError):
+                    pass
+                c.close()
+            gate = _Gate(sink, on_reset)
+            server_conn._fault_srx_gate = gate
+            self._gates.append(gate)
+        if wants_reset:
+            # deliver a strict prefix, then die: the server codec is
+            # left holding a half frame when teardown runs
+            cut = self._streams['server_rx'].randrange(len(data)) \
+                if len(data) > 1 else 0
+            if cut:
+                gate.push(data[:cut])
+            gate.push_reset()
+            return True
+        cut = self._streams['server_rx'].randrange(1, len(data)) \
+            if len(data) > 1 else 0
+        lo, hi = cfg.server_rx_delay_ms
+        delay = self._streams['server_rx'].uniform(lo, hi)
         if cut:
             gate.push(data[:cut])
             gate.push(data[cut:], delay)

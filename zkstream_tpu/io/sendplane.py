@@ -50,6 +50,13 @@ gates BEFORE bytes reach any queue, and a disabled cork bypasses the
 tier entirely (the frame-per-syscall validator).  The
 ``ZKSTREAM_FLUSH_CAP`` env (``flush_cap=`` on Client / ZKServer)
 resizes the early-flush cap.
+
+The receive direction mirrors this stack one module over
+(io/ingress.py): accept shards + one batched receive drain per dirty
+shard per tick beneath the unchanged decode path, with the
+connection's accept shard doubling as its watch fan-out shard — so a
+connection's corked replies, buffered notifications and drained
+requests all live with one shard.
 """
 
 from __future__ import annotations

@@ -224,7 +224,7 @@ class ElectionCoordinator(EventEmitter):
     # -- liveness --
 
     def _alive(self, idx: int) -> bool:
-        return self.servers[idx]._server is not None
+        return self.servers[idx].listening
 
     def leader_alive(self) -> bool:
         return self._alive(self.leader_idx) \

@@ -25,6 +25,8 @@ import logging
 
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
+from ..io.ingress import METRIC_RECV_SYSCALLS, make_plane, \
+    rx_buf_default
 from ..io.sendplane import SendPlane
 from ..protocol.framing import PacketCodec
 from ..utils.aio import set_nodelay
@@ -82,6 +84,16 @@ class ServerConnection:
         #: connection's fate exactly once.
         self._admin_buf = b''
         self._admin_checked = False
+        #: Sharded-ingress state (io/ingress.py): the owning plane
+        #: (None on the single-loop validator path), this
+        #: connection's accept shard — the affinity key its watch
+        #: fan-out shard reuses — and the raw fd + dirty flag the
+        #: shard's batched receive drain keys on.
+        self._ingress = None
+        self._ingress_shard: int | None = None
+        self._rx_fd = -1
+        self._rx_dirty = False
+        self._rx_skip = False
         #: Outbound cork (io/sendplane.py): replies and notifications
         #: of one event-loop tick leave as a single writer.write (a
         #: pipelined request batch is answered with one segment) —
@@ -257,87 +269,122 @@ class ServerConnection:
     # -- lifecycle --
 
     async def run(self) -> None:
+        """The single-loop validator's receive pump (the sharded
+        ingress plane never calls this — its per-shard batched drain
+        feeds :meth:`feed` directly)."""
+        rx_buf = self.server.rx_buf
+        ctr = self.server._recv_ctr
+        labels = self.server._recv_labels
         try:
             while not self.closed:
-                data = await self.reader.read(65536)
+                data = await self.reader.read(rx_buf)
                 if not data:
                     break
-                if not self._admin_checked:
-                    # ZooKeeper four-letter words arrive raw (no
-                    # length prefix) as the connection's first bytes.
-                    self._admin_buf += data
-                    if len(self._admin_buf) < 4:
-                        continue
-                    self._admin_checked = True
-                    word = self._admin_buf[:4]
-                    if word in ADMIN_WORDS:
-                        await self._handle_admin(word.decode('ascii'))
-                        break
-                    # not an admin word: replay everything buffered
-                    # through the normal codec path
-                    data, self._admin_buf = self._admin_buf, b''
-                # the tick ledger's decode_apply phase covers the
-                # whole decode + dispatch burst (store apply and WAL
-                # append included; nested sync/flush phases subtract)
-                ledger = self.server.ledger
-                if ledger is not None:
-                    ledger.enter('decode_apply')
-                try:
-                    try:
-                        pkts = self.codec.decode(data)
-                    except ZKProtocolError as e:
-                        log.debug('server: undecodable input: %s', e)
-                        break
-                    trace = self.server.trace
-                    if trace is not None and pkts and not (
-                            len(pkts) == 1
-                            and pkts[0].get('opcode') == 'PING'):
-                        # bare keepalive pings skip the ring: at fleet
-                        # scale they are most batches, and recording
-                        # them would wash the txn chains out of the
-                        # bounded window (and cost a span per ping)
-                        trace.note('SRV_DECODE', kind='server',
-                                   batch=len(pkts), nbytes=len(data))
-                    # Outstanding accounting is batch-scoped: a
-                    # pipelined read delivers N requests at once, and
-                    # every one is outstanding until its handler
-                    # replies.  (Handlers are synchronous today, so a
-                    # concurrent mntr scrape observes nonzero only
-                    # across a handler that awaits — e.g. via an
-                    # injected fault gate — but the accounting stays
-                    # correct if handlers ever grow await points.)
-                    self.server.outstanding += len(pkts)
-                    remaining = len(pkts)
-                    try:
-                        for pkt in pkts:
-                            self.server.packets_received += 1
-                            if self.codec.handshaking:
-                                self._handle_connect(pkt)
-                            else:
-                                self._handle_request(pkt)
-                            self.server.outstanding -= 1
-                            remaining -= 1
-                            if self.closed:
-                                break
-                    finally:
-                        # a close/raise mid-batch must still retire
-                        # the unhandled remainder from the gauge
-                        self.server.outstanding -= remaining
-                finally:
-                    if ledger is not None:
-                        ledger.exit()
+                if ctr is not None:
+                    # the rx-direction syscall accounting's validator
+                    # arm: one wakeup, one read per connection
+                    ctr.increment(labels)
+                if not self.feed(data):
+                    break
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             self.close()
 
-    async def _handle_admin(self, word: str) -> None:
+    def feed(self, data: bytes) -> bool:
+        """Decode + dispatch one received chunk (any byte offset: the
+        codec accumulates partial frames).  Both receive paths end
+        here — the validator's ``read()`` loop above and the ingress
+        plane's batched drain.  Returns False when the connection is
+        done (admin word served, undecodable input).
+
+        Fault injection happens HERE, per connection-chunk, BEFORE
+        any decode — the receive-side mirror of the send plane's
+        before-the-cork rule: an injected split/delay/reset perturbs
+        this connection's stream identically on every rx backend."""
+        fi = self.server.faults
+        if fi is not None and fi.server_rx(self, data):
+            return True   # the injector took over delivery
+        return self._feed(data)
+
+    def _feed(self, data: bytes) -> bool:
+        """The injector-free half of :meth:`feed` (fault gates
+        deliver their delayed segments through this, so a faulted
+        chunk is never re-screened)."""
+        if not self._admin_checked:
+            # ZooKeeper four-letter words arrive raw (no length
+            # prefix) as the connection's first bytes.
+            self._admin_buf += data
+            if len(self._admin_buf) < 4:
+                return True
+            self._admin_checked = True
+            word = self._admin_buf[:4]
+            if word in ADMIN_WORDS:
+                self._handle_admin(word.decode('ascii'))
+                return False
+            # not an admin word: replay everything buffered
+            # through the normal codec path
+            data, self._admin_buf = self._admin_buf, b''
+        # the tick ledger's decode_apply phase covers the
+        # whole decode + dispatch burst (store apply and WAL
+        # append included; nested sync/flush phases subtract)
+        ledger = self.server.ledger
+        if ledger is not None:
+            ledger.enter('decode_apply')
+        try:
+            try:
+                pkts = self.codec.decode(data)
+            except ZKProtocolError as e:
+                log.debug('server: undecodable input: %s', e)
+                return False
+            trace = self.server.trace
+            if trace is not None and pkts and not (
+                    len(pkts) == 1
+                    and pkts[0].get('opcode') == 'PING'):
+                # bare keepalive pings skip the ring: at fleet
+                # scale they are most batches, and recording
+                # them would wash the txn chains out of the
+                # bounded window (and cost a span per ping)
+                trace.note('SRV_DECODE', kind='server',
+                           batch=len(pkts), nbytes=len(data))
+            # Outstanding accounting is batch-scoped: a
+            # pipelined read delivers N requests at once, and
+            # every one is outstanding until its handler
+            # replies.  (Handlers are synchronous today, so a
+            # concurrent mntr scrape observes nonzero only
+            # across a handler that awaits — e.g. via an
+            # injected fault gate — but the accounting stays
+            # correct if handlers ever grow await points.)
+            self.server.outstanding += len(pkts)
+            remaining = len(pkts)
+            try:
+                for pkt in pkts:
+                    self.server.packets_received += 1
+                    if self.codec.handshaking:
+                        self._handle_connect(pkt)
+                    else:
+                        self._handle_request(pkt)
+                    self.server.outstanding -= 1
+                    remaining -= 1
+                    if self.closed:
+                        break
+            finally:
+                # a close/raise mid-batch must still retire
+                # the unhandled remainder from the gauge
+                self.server.outstanding -= remaining
+        finally:
+            if ledger is not None:
+                ledger.exit()
+        return True
+
+    def _handle_admin(self, word: str) -> None:
         """Serve one four-letter admin word: raw text reply, then
-        close — real ZK's mntr/ruok/stat/srvr contract."""
+        close — real ZK's mntr/ruok/stat/srvr contract.  Synchronous:
+        ``transport.close`` flushes the buffered reply before the FIN
+        on both receive paths."""
         text = self.server.admin_text(word)
         try:
             self.writer.write(text.encode('utf-8'))
-            await self.writer.drain()
         except (ConnectionError, RuntimeError):
             pass
         self.close()
@@ -352,6 +399,8 @@ class ServerConnection:
         self._tx.flush_hard()
         self.closed = True
         self._unsubscribe()
+        if self._ingress is not None:
+            self._ingress.forget(self)
         if self.session is not None and self.session.owner is self:
             self.session.owner = None
         self.server.conns.discard(self)
@@ -567,7 +616,9 @@ class ZKServer:
                  member: str | None = None,
                  trace: bool | None = None,
                  transport: str | None = None,
-                 flush_cap: int | None = None):
+                 flush_cap: int | None = None,
+                 ingress_shards: int | None = None,
+                 ingress_backend: str | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -648,6 +699,30 @@ class ZKServer:
         self.transport_tier = make_tier(transport, collector=collector,
                                         plane='server',
                                         ledger=self.ledger)
+        #: Shared-nothing ingress (io/ingress.py): N accept shards,
+        #: each draining its dirty connections' bytes in ONE batched
+        #: receive per busy tick, replacing the per-connection
+        #: ``reader.read`` task wakeup.  None = the single-loop
+        #: validator (``ingress_shards=1`` / ``ZKSTREAM_INGRESS_
+        #: SHARDS=1`` / a resolved ``asyncio`` backend via
+        #: ``ZKSTREAM_INGRESS``), which keeps ``asyncio.start_server``
+        #: exactly as before.  ``rx_buf`` is the receive-buffer size
+        #: both paths read with (``ZKSTREAM_RX_BUF``, formerly the
+        #: hardcoded 65536).
+        self.rx_buf = rx_buf_default()
+        self.ingress = make_plane(self, ingress_shards,
+                                  ingress_backend,
+                                  collector=collector)
+        #: rx-direction syscall accounting for the validator path
+        #: (the ingress plane counts its own drains): one increment
+        #: per ``reader.read`` wakeup, same metric, same label keys.
+        self._recv_ctr = None
+        self._recv_labels = {'plane': 'server', 'backend': 'asyncio'}
+        if collector is not None:
+            self._recv_ctr = collector.counter(
+                METRIC_RECV_SYSCALLS,
+                'Receive submissions issued by the ingress plane, by '
+                'plane and backend')
         self._server: asyncio.base_events.Server | None = None
         self.conns: set[ServerConnection] = set()
         #: Fault-injection knobs for tests: swallow pings (forces the
@@ -675,6 +750,11 @@ class ZKServer:
         #: per-connection emitter path), True/False force.
         enabled = watchtable_default() if watchtable is None \
             else watchtable
+        if fanout_shards is None and self.ingress is not None:
+            # ingress affinity: one fan-out shard per accept shard,
+            # so a connection's arms, fan-out buffer and send-plane
+            # cork all key off the shard that drains it
+            fanout_shards = self.ingress.nshards
         self.watch_table = WatchTable(self, shards=fanout_shards,
                                       collector=collector) \
             if enabled else None
@@ -767,6 +847,17 @@ class ZKServer:
     BACKLOG = 1024
 
     async def start(self) -> 'ZKServer':
+        if self.ingress is not None:
+            # sharded ingress: per-shard SO_REUSEPORT listeners (or
+            # the dispatcher handoff) + batched receive drains; the
+            # single-loop asyncio.start_server path below stays the
+            # env-gated validator
+            self.ingress.start(self.host, self.port)
+            self.port = self.ingress.port
+            log.info('ZK server listening on %s:%d (%d ingress '
+                     'shards, %s)', self.host, self.port,
+                     self.ingress.nshards, self.ingress.backend)
+            return self
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port,
             backlog=self.BACKLOG)
@@ -796,6 +887,10 @@ class ZKServer:
         A WAL this server opened itself is closed (final fsync, fd
         released) — ``restart`` reopens it; an ensemble's shared WAL
         belongs to the ensemble (ZKEnsemble.stop)."""
+        if self.ingress is not None:
+            # listeners first: no accept can land between severing
+            # the fleet and releasing the port
+            self.ingress.stop()
         for conn in list(self.conns):
             conn.close()
         self.conns.clear()
@@ -805,6 +900,11 @@ class ZKServer:
             # handlers to return, so connections must be severed first.
             await self._server.wait_closed()
             self._server = None
+        if self.ingress is not None:
+            # the sharded twin of wait_closed: every severed
+            # connection's transport teardown has run before stop()
+            # returns, so an in-process peer observes the close
+            await self.ingress.wait_closed()
         if self._owns_wal and not self.db.wal.closed:
             self.db.wal.close()
         if self.transport_tier is not None:
@@ -826,7 +926,9 @@ class ZKServer:
         the replayed tail (server/persist.py).  Standalone/leader
         only; it requires a WAL and drops every session, exactly like
         a real restart."""
-        assert self._server is None, 'server still running'
+        assert self._server is None and (
+            self.ingress is None or not self.ingress.running), \
+            'server still running'
         if from_disk:
             assert self.store is self.db, \
                 'restart-from-disk rebuilds the leader database'
@@ -834,6 +936,9 @@ class ZKServer:
         elif self.db.wal is not None and self.db.wal.closed:
             self.db.wal.reopen()     # stop() closed it with the member
         self.store.catch_up()
+        if self.ingress is not None:
+            self.ingress.start(self.host, self.port)
+            return self
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port,
             backlog=self.BACKLOG)
@@ -842,6 +947,15 @@ class ZKServer:
     @property
     def address(self) -> tuple[str, int]:
         return (self.host, self.port)
+
+    @property
+    def listening(self) -> bool:
+        """True while this member accepts connections — on whichever
+        receive path it runs (the sharded ingress plane or the
+        single-loop validator's asyncio server).  The election
+        coordinator's liveness probe reads this."""
+        return (self._server is not None
+                or (self.ingress is not None and self.ingress.running))
 
     # -- four-letter admin words (ruok / mntr / stat / srvr) --
 
@@ -974,7 +1088,22 @@ class ZKServer:
             ('zk_transport_backend',
              'asyncio' if self.transport_tier is None
              else self.transport_tier.backend),
-        ] + multi_rows + quorum_rows + tick_rows + wal_rows
+            ('zk_ingress_shards',
+             1 if self.ingress is None else self.ingress.nshards),
+            ('zk_ingress_backend',
+             'asyncio' if self.ingress is None
+             else self.ingress.backend),
+        ] + self._ingress_census_rows() + multi_rows + quorum_rows \
+            + tick_rows + wal_rows
+
+    def _ingress_census_rows(self) -> list[tuple[str, object]]:
+        """Per-shard connection census (sharded ingress only): how
+        evenly the kernel (SO_REUSEPORT) or the dispatcher spread the
+        fleet across accept shards."""
+        if self.ingress is None:
+            return []
+        return [('zk_ingress_shard_conns{shard="%d"}' % (i,), n)
+                for i, n in enumerate(self.ingress.shard_census())]
 
     def admin_text(self, word: str) -> str:
         """Render one four-letter word's reply text."""
@@ -1041,7 +1170,8 @@ class ZKEnsemble:
                  heartbeat_ms: int | None = None,
                  seed: int | None = None,
                  transport: str | None = None,
-                 quorum: bool | None = None):
+                 quorum: bool | None = None,
+                 ingress_shards: int | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -1075,7 +1205,8 @@ class ZKEnsemble:
                      store=None if i == 0 else ReplicaStore(self.db,
                                                             lag=lag),
                      watchtable=watchtable, member=str(i),
-                     transport=transport)
+                     transport=transport,
+                     ingress_shards=ingress_shards)
             for i in range(count)]
         #: Quorum leader election (server/election.py): on by default;
         #: ``election=False`` / ``ZKSTREAM_NO_ELECTION=1`` keeps the

@@ -186,8 +186,17 @@ class WatchTable:
     # -- connection membership --
 
     def add_conn(self, conn) -> None:
-        """Assign a freshly-handshaken connection to a shard
-        (round-robin: deterministic and balanced)."""
+        """Assign a freshly-handshaken connection to a shard.  A
+        connection accepted through the sharded ingress plane keeps
+        its ACCEPT shard as its fan-out shard (io/ingress.py: the
+        affinity key — arms, fan-out buffer and send-plane cork all
+        live with the shard that drains the connection); validator-
+        path connections round-robin as before (deterministic and
+        balanced)."""
+        shard = getattr(conn, '_ingress_shard', None)
+        if shard is not None:
+            conn._fanout_shard = shard % self.nshards
+            return
         conn._fanout_shard = self._rr % self.nshards
         self._rr += 1
 

@@ -271,6 +271,9 @@ class TickLedger:
 
     Phases (server/server.py wires them):
 
+    - ``rx_drain`` — the ingress plane's batched receive: kernel-to-
+      user time for one shard's dirty set (io/ingress.py; ~0 on the
+      single-loop validator, whose reads are awaited, not drained);
     - ``decode_apply`` — request decode + handler dispatch (store
       apply and WAL append included, minus nested phases);
     - ``fsync_gate`` — loop-blocking durability-barrier time (the
@@ -293,7 +296,7 @@ class TickLedger:
     scraping (``scrape_tick_cells`` summarizes them per bench cell).
     """
 
-    PHASES = ('decode_apply', 'fsync_gate', 'cork_flush',
+    PHASES = ('rx_drain', 'decode_apply', 'fsync_gate', 'cork_flush',
               'fanout_flush')
 
     #: Close a still-active burst after this many loop iterations
@@ -321,8 +324,9 @@ class TickLedger:
         source = collector if collector is not None else Collector()
         self.phase_hist = source.histogram(
             METRIC_TICK_PHASE,
-            'Busy-tick time by phase, ms (decode_apply | fsync_gate '
-            '| cork_flush | fanout_flush)', buckets=TICK_BUCKETS)
+            'Busy-tick time by phase, ms (rx_drain | decode_apply | '
+            'fsync_gate | cork_flush | fanout_flush)',
+            buckets=TICK_BUCKETS)
         self.tick_hist = source.histogram(
             METRIC_TICK, 'Busy-tick wall span, ms',
             buckets=TICK_BUCKETS)

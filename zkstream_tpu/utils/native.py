@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 7
+_EXT_ABI_VERSION = 8
 
 _ext = None
 _ext_load_failed = False
