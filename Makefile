@@ -7,9 +7,10 @@ PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check analyze native bench asan ubsan sanitize \
-    chaos chaos-ensemble obs durability election bench-wal \
-    bench-fanout bench-trace bench-election bench-transport \
-    bench-ingress bench-quorum timeline coverage clean
+    chaos chaos-ensemble obs durability election linearize \
+    bench-wal bench-fanout bench-trace bench-election \
+    bench-transport bench-ingress bench-quorum bench-linearize \
+    timeline coverage clean
 
 all: check test
 
@@ -151,6 +152,30 @@ timeline:
 # ZKSTREAM_BENCH_TRACE_ROUNDS.
 bench-trace:
 	$(PYTHON) bench.py --traceov
+
+# Linearizability plane (analysis/linearize.py; README
+# "Linearizability"): the checker's own violation corpus
+# (tests/linearize_corpus — every known-bad history flagged with a
+# counterexample window, every known-good one clean), the interval-
+# model units, and the concurrent tier's bounded slices: N clients
+# writing overlapping keys through member churn, every history
+# checked per key (invariant 9).  The full 120-schedule campaign
+# runs under the slow marker (pytest tests/test_linearize.py -m
+# slow).  Rerun a failing seed with `python -m zkstream_tpu chaos
+# --tier ensemble --clients 3 --seed N --schedules 1`.
+linearize:
+	$(PYTHON) -m pytest tests/test_linearize.py -q -m 'not slow'
+	$(PYTHON) -m pytest tests/test_chaos_ensemble.py -q \
+	    -k 'concurrent' -m 'not slow'
+
+# WGL cost guard: check time vs history length/width cells over
+# synthetic-but-valid concurrent histories (every finding there
+# would be a checker false positive).  Asserts the per-key
+# partition + zxid pruning + greedy no-effect commits keep the
+# campaign-shaped cell under its budget (table in PROFILE.md
+# "Linearizability checker").
+bench-linearize:
+	$(PYTHON) tools/bench_linearize.py
 
 check: analyze
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)

@@ -383,6 +383,33 @@ async def test_ensemble_campaign_tier1_slice():
     assert not bad, _campaign_failure_report(bad)
 
 
+@pytest.mark.timeout(300)
+async def test_concurrent_campaign_tier1_slice():
+    """The concurrent tier's bounded slice: N clients writing
+    overlapping keys per schedule, the per-key WGL linearizability
+    pass (invariant 9) on every history, and the scrape-after-chaos
+    assertion extended to N clients — the FSM census sums every
+    client's machines, so a single leaked per-client transitional
+    state fails here.  Scale with ZKSTREAM_CHAOS_CONC_TIER1; rerun
+    any seed with `python -m zkstream_tpu chaos --tier ensemble
+    --clients 3 --seed N --schedules 1`."""
+    from zkstream_tpu.io.faults import run_concurrent_schedule
+
+    n = int(os.environ.get('ZKSTREAM_CHAOS_CONC_TIER1', '12'))
+    bad = []
+    for seed in range(BASE_SEED, BASE_SEED + n):
+        collector = Collector()
+        r = await run_concurrent_schedule(seed, clients=3,
+                                          collector=collector)
+        assert r.clients == 3
+        assert any(rec['kind'] == 'invoke' for rec in r.history), \
+            'seed %d recorded no interval ops' % (seed,)
+        _assert_clean_scrape(collector, r)
+        if not r.ok:
+            bad.append(r)
+    assert not bad, _campaign_failure_report(bad)
+
+
 @pytest.mark.timeout(180)
 async def test_forced_election_schedules_pass_invariants():
     """The election plane's ensemble-tier acceptance: seeded

@@ -384,6 +384,16 @@ def build_parser() -> argparse.ArgumentParser:
                          'fan-out table (server/watchtable.py) — '
                          'bisects whether a failing seed implicates '
                          'the table')
+    ch.add_argument('--clients', type=int, default=None,
+                    help='ensemble/process tiers: drive N CONCURRENT '
+                         'clients over a small shared key set '
+                         '(io/faults.py run_concurrent_schedule) and '
+                         'check the two-sided history per key with '
+                         'the WGL linearizability pass '
+                         '(analysis/linearize.py, invariant 9).  '
+                         'Part of the rerun key: seed + this flag '
+                         'reproduce the schedule exactly.  Default: '
+                         '1 (the classic single-client workload)')
     ch.add_argument('--elections', type=int, default=None,
                     help='ensemble tier: force N leader elections '
                          'per schedule (kill the current leader at '
@@ -509,13 +519,15 @@ async def _chaos(args) -> int:
             return
         status = 'ok ' if r.ok else 'FAIL'
         print('seed %6d  %s  ops=%d acked=%d typed_errs=%d '
-              'deadline=%d faults=%d watch_fires=%d%s%s'
+              'deadline=%d faults=%d watch_fires=%d%s%s%s'
               % (r.seed, status, r.ops, r.acked, r.typed_errors,
                  r.deadline_errors, r.faults, r.watch_fires,
                  '' if r.tier == 'transport'
                  else ' member_events=%d' % (len(r.member_events),),
                  '' if not r.elections
-                 else ' elections=%d' % (r.elections,)))
+                 else ' elections=%d' % (r.elections,),
+                 '' if r.clients <= 1
+                 else ' clients=%d' % (r.clients,)))
         for v in r.violations:
             print('    violation: %s' % (v,))
         if not r.ok and r.history:
@@ -523,6 +535,12 @@ async def _chaos(args) -> int:
             if timeline:
                 print('  member-event timeline:')
                 print(timeline)
+            if any(rec['kind'] == 'invoke' for rec in r.history):
+                # the concurrent tier: a linearizability
+                # counterexample window (in the violations above) is
+                # read against the per-client interleaving
+                print('  per-client interleaving:')
+                print(format_history(r.history, columns=True))
         if not r.ok and r.trace:
             print('  span ring (oldest first):')
             print(format_spans(r.trace))
@@ -542,7 +560,8 @@ async def _chaos(args) -> int:
             args.seed, args.schedules,
             ops=args.ops if args.ops is not None else 12,
             progress=progress,
-            elections=getattr(args, 'elections', None))
+            elections=getattr(args, 'elections', None),
+            clients=getattr(args, 'clients', None))
     elif args.tier == 'process':
         if getattr(args, 'no_election', False):
             # the process tier IS the election plane: there is no
@@ -556,8 +575,14 @@ async def _chaos(args) -> int:
             args.seed, args.schedules,
             ops=args.ops if args.ops is not None else 6,
             progress=progress,
-            elections=getattr(args, 'elections', None))
+            elections=getattr(args, 'elections', None),
+            clients=getattr(args, 'clients', None))
     else:
+        if getattr(args, 'clients', None) and args.clients > 1:
+            print('error: --clients needs the history-checked '
+                  'tiers; use --tier ensemble or --tier process',
+                  file=sys.stderr)
+            return 2
         results = await run_campaign(
             args.seed, args.schedules,
             ops=args.ops if args.ops is not None else 6,
@@ -590,9 +615,13 @@ async def _chaos(args) -> int:
              sum(r.typed_errors for r in results),
              sum(r.deadline_errors for r in results)))
     if bad:
+        clients = getattr(args, 'clients', None)
         print('failing seeds (rerun: python -m zkstream_tpu chaos '
-              '--tier %s --seed N --schedules 1): %s'
-              % (args.tier, ', '.join(str(r.seed) for r in bad)),
+              '--tier %s%s --seed N --schedules 1): %s'
+              % (args.tier,
+                 ' --clients %d' % (clients,)
+                 if clients and clients > 1 else '',
+                 ', '.join(str(r.seed) for r in bad)),
               file=sys.stderr)
         return 1
     return 0

@@ -618,6 +618,39 @@ async def _bounded_op(res: 'ScheduleResult', coro, what: str,
         return False, None
 
 
+def record_settle_error(res: 'ScheduleResult', h, call_id: int,
+                        exc) -> None:
+    """Classify one typed op failure into its interval settle plus
+    the shared tallies — ONE ladder for both concurrent tiers
+    (io/faults.py ``run_concurrent_schedule`` and the process tier's
+    concurrent workload, server/election.py), the ``_bounded_op``
+    no-drift discipline applied to two-sided records: a definite
+    spec verdict settles ``'error'``, a rejected MULTI likewise
+    (whole-batch, no effect), an op that provably never left the
+    client (not-connected) or bounced on the epoch fence settles
+    ``'fail'`` (excluded from the search), and everything else —
+    the outcome-unknown family included — settles ``'unknown'``."""
+    from ..analysis.linearize import SPEC_ERRORS
+    from ..protocol.errors import ZKMultiError
+
+    res.typed_errors += 1
+    code = getattr(exc, 'code', None) or type(exc).__name__
+    if code == 'DEADLINE_EXCEEDED':
+        res.deadline_errors += 1
+    if isinstance(exc, ZKNotConnectedError):
+        h.settle(call_id, 'fail', error='NOT_CONNECTED')
+    elif isinstance(exc, ZKMultiError):
+        h.settle(call_id, 'error', error='MULTI_REJECTED')
+    elif code in SPEC_ERRORS:
+        h.settle(call_id, 'error', error=code)
+    elif code == 'EPOCH_FENCED':
+        # a typed fencing bounce: neither acked nor silently applied
+        # (README "Failure semantics")
+        h.settle(call_id, 'fail', error=code)
+    else:
+        h.settle(call_id, 'unknown', error=code)
+
+
 def _note_open_spans(res: 'ScheduleResult', trace) -> None:
     """Teardown invariant shared by both campaign tiers: every span
     must be settled once the client is closed — an op evicted from the
@@ -654,6 +687,11 @@ class ScheduleResult:
     #: Which campaign tier produced this result ('transport' or
     #: 'ensemble').
     tier: str = 'transport'
+    #: How many concurrent clients drove the schedule (1 = the
+    #: classic single-client workload; >1 = the concurrent tier,
+    #: ``run_concurrent_schedule`` — part of the rerun key:
+    #: ``chaos --tier ensemble --clients N --seed S``).
+    clients: int = 1
     #: Ensemble tier only: the member-event timeline (kill / restart /
     #: partition / heal / lag / migrate), in schedule order — printed
     #: next to the seed on failure so the failing interleaving of
@@ -1129,14 +1167,21 @@ class EnsembleUnderTest:
 async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 collector=None,
                                 plan: FaultPlan | None = None,
-                                elections: int | None = None
+                                elections: int | None = None,
+                                clients: int | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
-    concurrent client workload, every op recorded into an append-only
-    history, then the five history invariants (io/invariants.py)
-    checked against the leader's final database.  Any failure is
-    reproducible with ``python -m zkstream_tpu chaos --tier ensemble
-    --seed N``."""
+    client workload, every op recorded into an append-only history,
+    then the history invariants (io/invariants.py) checked against
+    the leader's final database.  ``clients`` > 1 switches to the
+    concurrent tier (:func:`run_concurrent_schedule`): N clients
+    writing overlapping keys, checked per key for linearizability
+    (invariant 9).  Any failure is reproducible with ``python -m
+    zkstream_tpu chaos --tier ensemble --seed N [--clients N]``."""
+    if clients is not None and clients > 1:
+        return await run_concurrent_schedule(
+            seed, ops=ops, clients=clients, collector=collector,
+            plan=plan, elections=elections)
     from ..client import Client
     from ..protocol.consts import CreateFlag
     from .backoff import BackoffPolicy
@@ -1592,15 +1637,450 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
 
 async def run_ensemble_campaign(base_seed: int, schedules: int,
                                 ops: int = 12, progress=None,
-                                elections: int | None = None
+                                elections: int | None = None,
+                                clients: int | None = None
                                 ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
-    starting at ``base_seed``."""
+    starting at ``base_seed`` (``clients`` > 1: the concurrent
+    tier, every schedule linearizability-checked)."""
     out = []
     for i in range(schedules):
         r = await run_ensemble_schedule(base_seed + i, ops=ops,
-                                        elections=elections)
+                                        elections=elections,
+                                        clients=clients)
         out.append(r)
         if progress is not None:
             progress(r)
     return out
+
+
+# ---------------------------------------------------------------------
+# Concurrent tier: N clients writing OVERLAPPING keys through the
+# full fault vocabulary (kills, elections, partitions, disk faults,
+# server_rx), every op recorded as a two-sided interval
+# (History.invoke/settle), checked per key by the WGL
+# linearizability pass (analysis/linearize.py, invariant 9).  Shared
+# by tests/test_linearize.py, tests/test_chaos_ensemble.py and
+# ``chaos --tier ensemble --clients N``.
+# ---------------------------------------------------------------------
+
+#: The shared key set the concurrent workload contends on — small by
+#: design: overlap is what exposes lost updates and stale reads.
+CONCURRENT_KEYS = ('/k0', '/k1', '/k2')
+
+#: Per-client workload mix (repetition = weight): read-heavy enough
+#: that most writes are observed by somebody else's read.
+CONCURRENT_ACTIONS = ('set', 'set', 'set', 'get', 'get', 'get',
+                      'exists', 'create', 'create', 'delete',
+                      'multi')
+
+#: The churn driver's mix (its own RNG stream — per-client streams
+#: and churn draws are fresh, so existing single-client seeds are
+#: unperturbed).  'pause' keeps churn sparser than ops.
+CONCURRENT_CHURN = ('kill_any', 'kill_leader', 'restart', 'restart',
+                    'partition', 'lag', 'migrate',
+                    'pause', 'pause', 'pause')
+
+
+async def run_concurrent_schedule(seed: int, ops: int = 12,
+                                  clients: int = 3,
+                                  collector=None,
+                                  plan: FaultPlan | None = None,
+                                  elections: int | None = None
+                                  ) -> ScheduleResult:
+    """One seeded concurrent schedule: ``clients`` Clients driven
+    from per-client RNG streams drawn fresh from the FaultPlan, each
+    issuing ``ops`` overlapping create/set/delete/get/exists/multi
+    ops on :data:`CONCURRENT_KEYS` while a churn driver kills,
+    restarts, partitions and lags members (forced elections
+    included).  Reads record their observed data/version/mzxid;
+    writes their reply zxid; outcome-unknown ops stay ambiguous.
+    After the schedule ``check_history`` replays the history — on a
+    concurrent history the binding checks are invariants 2 (zxid
+    monotone per session), 5 (watch at-most-once), 7 (elections)
+    and 9 (per-key WGL linearizability, pinned to the leader's
+    final tree: acked-write loss and torn MULTIs on the shared keys
+    surface through that pinning, not through the single-client
+    tier's ``ack``/``multi`` records, which this tier does not
+    emit) — and the crash-image recovery is checked against the
+    zxid-ordered replay prefix
+    (:func:`~zkstream_tpu.analysis.linearize.check_recovered_prefix`).
+    Rerun any failure with ``python -m zkstream_tpu chaos --tier
+    ensemble --clients N --seed S``."""
+    from ..client import Client
+    from .backoff import BackoffPolicy
+    from .invariants import History, check_ephemerals, check_history
+    from .pool import DEFAULT_DECOHERENCE_INTERVAL
+
+    import shutil
+    import tempfile
+
+    if plan is None:
+        plan = FaultPlan.randomized(seed, ops=ops)
+    if elections is not None:
+        plan.elections = elections
+    inj = FaultInjector(seed, plan.config)
+    res = ScheduleResult(seed=seed, tier='ensemble',
+                         clients=clients)
+    h = History()
+    rngs = [random.Random('client/%d/%d' % (seed, ci))
+            for ci in range(clients)]
+    crng = random.Random('churn/%d' % (seed,))
+
+    wal_dir = tempfile.mkdtemp(prefix='zkchaos-conc-wal-')
+    crash_dir = tempfile.mkdtemp(prefix='zkchaos-conc-crash-')
+    ens = await EnsembleUnderTest(
+        plan.members, wal_dir=wal_dir, durability=plan.durability,
+        wal_segment_bytes=plan.wal_segment_bytes, seed=seed).start()
+    ens.install_faults(inj)
+
+    ingest = None
+    if plan.ingest_mode != 'none':
+        from .ingest import FleetIngest
+        # ONE shared ingest across all N clients — shared batched
+        # drains are the plane's deployment shape
+        ingest = FleetIngest(
+            body_mode='host', max_frames=8,
+            bypass_bytes=0 if plan.ingest_mode == 'batch' else 16384)
+        ingest.faults = inj
+
+    spans: list = [None] * clients
+    cls: list = []
+    for ci in range(clients):
+        c = Client(
+            servers=ens.addresses(), shuffle_backends=False,
+            session_timeout=plan.session_timeout,
+            seed=seed * 131 + ci, faults=inj,
+            op_timeout=CAMPAIGN_OP_DEADLINE_MS, collector=collector,
+            ingest=ingest, trace_capacity=512,
+            decoherence_interval=(plan.decoherence_ms
+                                  if plan.decoherence_ms is not None
+                                  else DEFAULT_DECOHERENCE_INTERVAL),
+            connect_policy=BackoffPolicy(timeout=400, retries=2,
+                                         delay=30, cap=200),
+            default_policy=BackoffPolicy(timeout=400, retries=3,
+                                         delay=50, cap=400))
+
+        def on_op(span, ci=ci):
+            spans[ci] = span
+            h.op(span.op, span.path, status=span.status,
+                 zxid=span.zxid,
+                 session_id=int(span.session_id, 16)
+                 if span.session_id else 0,
+                 error=span.error)
+        c.on_op = on_op
+        c.on('expire', lambda c=c: h.session_event(
+            'expired', c.session.session_id
+            if c.session is not None else 0))
+        cls.append(c)
+
+    def note_member(event: str, member) -> None:
+        h.member_event(event, member)
+        cls[0].trace.note('MEMBER_' + event.upper(),
+                          path='member:%s' % (member,),
+                          kind='member')
+
+    if ens.coordinator is None:
+        plan.elections = 0
+    else:
+        def on_elected(member, epoch, dur_ms):
+            h.election(member, epoch)
+            cls[0].trace.note('ELECTED',
+                              path='member:%s' % (member,),
+                              kind='member',
+                              detail='epoch=%d' % (epoch,),
+                              duration_ms=round(dur_ms, 3))
+        ens.coordinator.on('elected', on_elected)
+
+    def elections_seen() -> int:
+        return sum(1 for r in h.records if r['kind'] == 'election')
+
+    async def force_election() -> None:
+        if ens.coordinator is None:
+            return
+        need = len(ens.servers) // 2 + 1
+        while ens.dead and len(ens.live()) - 1 < need:
+            back = sorted(ens.dead)[0]
+            note_member('restart', back)
+            await ens.restart(back)
+        lead = ens.leader_idx
+        before = elections_seen()
+        if lead not in ens.dead:
+            note_member('kill-leader', lead)
+            await ens.kill(lead)
+        deadline = 8.0
+        step = 0.02
+        while elections_seen() <= before and deadline > 0:
+            await asyncio.sleep(step)
+            deadline -= step
+        if elections_seen() <= before:
+            res.violations.append(
+                'forced election: no successor elected within 8s '
+                'of killing leader %d' % (lead,))
+
+    async def usable(c, timeout: float) -> bool:
+        if c.is_connected():
+            return True
+        try:
+            await c.wait_connected(timeout=timeout, fail_fast=False)
+            return True
+        except (asyncio.TimeoutError, TimeoutError):
+            return False
+
+    async def call(ci: int, op: str, path: str | None, factory,
+                   data: bytes | None = None,
+                   version: int | None = None,
+                   subs: list | None = None):
+        """One interval-recorded op: invoke before the send, settle
+        on every completion path with the observed payload.  Returns
+        the op result on ack, None otherwise."""
+        call_id = h.invoke(op, path, client=ci, data=data,
+                           version=version, subs=subs)
+        try:
+            out = await asyncio.wait_for(factory(),
+                                         CAMPAIGN_OP_HARD_S)
+        except (ZKError, ZKProtocolError) as e:
+            record_settle_error(res, h, call_id, e)
+            return None
+        except (asyncio.TimeoutError, TimeoutError):
+            res.violations.append(
+                'client %d: %s %s hung past the %.1fs hard bound '
+                '(deadline %d ms never fired)'
+                % (ci, op, path, CAMPAIGN_OP_HARD_S,
+                   CAMPAIGN_OP_DEADLINE_MS))
+            h.settle(call_id, 'unknown', error='HARD_BOUND')
+            return None
+        span = spans[ci]
+        zxid = span.zxid if span is not None else None
+        if op == 'set':
+            h.settle(call_id, 'ok', zxid=out.mzxid,
+                     version=out.version)
+        elif op == 'get':
+            got, stat = out
+            h.settle(call_id, 'ok', zxid=stat.mzxid,
+                     data=bytes(got), version=stat.version)
+        elif op == 'exists':
+            h.settle(call_id, 'ok', zxid=out.mzxid,
+                     version=out.version)
+        else:                        # create / delete / multi
+            h.settle(call_id, 'ok', zxid=zxid)
+        if op not in ('get', 'exists'):
+            res.acked += 1
+        return out
+
+    fires: list = []
+    obs_ver: list[dict] = [{} for _ in range(clients)]
+
+    def pick_version(ci: int, key: str, rng) -> int:
+        """Mostly unconditional; 1-in-4 pins the last version this
+        client observed — BAD_VERSION under interleaving is a
+        definite spec verdict the checker must explain."""
+        if rng.random() < 0.25 and key in obs_ver[ci]:
+            return obs_ver[ci][key]
+        return -1
+
+    async def worker(ci: int) -> None:
+        c, rng = cls[ci], rngs[ci]
+        if not await usable(c, 10):
+            res.violations.append(
+                'client %d never connected within 10s (fault '
+                'budget %r should have exhausted)'
+                % (ci, inj.config.max_faults))
+            return
+        for step in range(ops):
+            await usable(c, 1.5)
+            res.ops += 1
+            act = rng.choice(CONCURRENT_ACTIONS)
+            key = rng.choice(CONCURRENT_KEYS)
+            tag = b'c%d-%d' % (ci, step)
+            if act == 'create':
+                await call(ci, 'create', key,
+                           lambda: c.create(key, tag), data=tag)
+            elif act == 'set':
+                ver = pick_version(ci, key, rng)
+                out = await call(
+                    ci, 'set', key,
+                    lambda: c.set(key, tag, version=ver),
+                    data=tag, version=ver)
+                if out is not None:
+                    obs_ver[ci][key] = out.version
+            elif act == 'delete':
+                ver = pick_version(ci, key, rng)
+                await call(ci, 'delete', key,
+                           lambda: c.delete(key, ver),
+                           version=ver)
+                # whatever the outcome, the cached version is stale
+                obs_ver[ci].pop(key, None)
+            elif act == 'get':
+                out = await call(ci, 'get', key,
+                                 lambda: c.get(key))
+                if out is not None:
+                    obs_ver[ci][key] = out[1].version
+            elif act == 'exists':
+                out = await call(ci, 'exists', key,
+                                 lambda: c.stat(key))
+                if out is not None:
+                    obs_ver[ci][key] = out.version
+            else:                     # multi: atomic across 2 keys
+                ka, kb = rng.sample(CONCURRENT_KEYS, 2)
+                da, db_ = tag + b'a', tag + b'b'
+                if rng.random() < 0.5:
+                    subs = [('set_data', ka, da, -1),
+                            ('set_data', kb, db_, -1)]
+                    mops = [{'op': 'set_data', 'path': ka,
+                             'data': da},
+                            {'op': 'set_data', 'path': kb,
+                             'data': db_}]
+                else:
+                    subs = [('create', ka, da, None),
+                            ('set_data', kb, db_, -1)]
+                    mops = [{'op': 'create', 'path': ka,
+                             'data': da},
+                            {'op': 'set_data', 'path': kb,
+                             'data': db_}]
+                await call(ci, 'multi', None,
+                           lambda: c.multi(mops), subs=subs)
+
+    async def churn() -> None:
+        forced = plan.forced_election_steps()
+        for i in range(ops):
+            if i in forced:
+                await force_election()
+            act = crng.choice(CONCURRENT_CHURN)
+            if act == 'kill_any':
+                live = ens.live()
+                if len(live) > 1:
+                    victim = crng.choice(live)
+                    note_member('kill', victim)
+                    await ens.kill(victim)
+            elif act == 'kill_leader':
+                lead = ens.leader_idx
+                if lead not in ens.dead and len(ens.live()) > 1:
+                    note_member('kill', lead)
+                    await ens.kill(lead)
+            elif act == 'restart':
+                if ens.dead:
+                    back = crng.choice(sorted(ens.dead))
+                    note_member('restart', back)
+                    await ens.restart(back)
+            elif act == 'partition':
+                if ens.partition_replica():
+                    note_member('partition', 'replica')
+                else:
+                    note_member('heal', 'replica')
+            elif act == 'lag':
+                idx = crng.choice(range(1, len(ens.servers)))
+                lag = crng.choice((None, 0.05, 0.0))
+                note_member('lag=%r' % (lag,), idx)
+                ens.set_lag(idx, lag)
+            elif act == 'migrate':
+                note_member('migrate', '-')
+                for c in cls:
+                    c.pool.rebalance_now()
+            await asyncio.sleep(crng.uniform(0.005, 0.04))
+
+    try:
+        for c in cls:
+            c.start()
+        if not await usable(cls[0], 10):
+            res.violations.append(
+                'client 0 never connected within 10s (fault budget '
+                '%r should have exhausted)'
+                % (inj.config.max_faults,))
+            return res
+
+        cls[0].watcher(CONCURRENT_KEYS[0]).on(
+            'dataChanged',
+            lambda data, stat: (fires.append(stat.mzxid),
+                                h.watch_fire(CONCURRENT_KEYS[0],
+                                             'dataChanged',
+                                             stat.mzxid)))
+
+        await asyncio.gather(churn(),
+                             *(worker(ci) for ci in range(clients)))
+
+        # -- verification: faults off, ensemble healed --------------
+        inj.stop()
+        ens.heal()
+        for back in sorted(ens.dead):
+            note_member('restart', back)
+            await ens.restart(back)
+        for j in range(1, len(ens.servers)):
+            ens.set_lag(j, 0.0)
+        if not await usable(cls[0], 10):
+            res.violations.append(
+                'never reconnected after every member was restarted '
+                'and faults stopped')
+        else:
+            try:
+                await asyncio.wait_for(
+                    cls[0].sync(CONCURRENT_KEYS[0]),
+                    CAMPAIGN_OP_HARD_S)
+            except (ZKError, ZKProtocolError,
+                    asyncio.TimeoutError, TimeoutError):
+                pass                  # sync is a barrier, not an op
+        res.watch_fires = len(fires)
+        forced_n = len(plan.forced_election_steps())
+        if forced_n and elections_seen() < forced_n:
+            res.violations.append(
+                'plan forced %d election(s) but only %d completed'
+                % (forced_n, elections_seen()))
+        # the full invariant engine, invariant 9 (per-key WGL
+        # linearizability pinned to the final tree) included
+        res.violations.extend(check_history(h, ens.db))
+
+        # -- durability: SIGKILL crash image + zxid-ordered replay --
+        wal = ens.db.wal
+        if wal is not None:
+            from ..analysis.linearize import check_recovered_prefix
+            from ..server.persist import recover_state
+            from ..server.store import ZKDatabase
+
+            before = inj.crash_window_before_fsync()
+            wal.materialize_crash(crash_dir, before_fsync=before)
+            h.member_event(
+                'sigkill-recover(%s-fsync)'
+                % ('before' if before else 'after'), 'ensemble')
+            rec = recover_state(crash_dir, trace=cls[0].trace)
+            rdb = ZKDatabase()
+            rdb.nodes = rec.nodes
+            rdb.zxid = rec.zxid
+            res.violations.extend(check_recovered_prefix(h, rdb))
+        return res
+    finally:
+        inj.stop()
+        res.faults = len(inj.fired)
+        for ci, c in enumerate(cls):
+            try:
+                await asyncio.wait_for(c.close(), 5)
+            except (asyncio.TimeoutError, TimeoutError):
+                c.pool.stop()
+                res.violations.append(
+                    'client %d close() hung past 5s' % (ci,))
+            except Exception as e:
+                c.pool.stop()
+                res.violations.append(
+                    'client %d close() raised: %r' % (ci, e))
+        res.violations.extend(
+            v for v in check_ephemerals(h, ens.db)
+            if v not in res.violations)
+        try:
+            await ens.stop()
+        except Exception as e:
+            res.violations.append('ensemble teardown raised: %r'
+                                  % (e,))
+        inj.close()
+        if ingest is not None:
+            ingest.close()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+        shutil.rmtree(crash_dir, ignore_errors=True)
+        for c in cls:
+            _note_open_spans(res, c.trace)
+        res.trace = cls[0].trace.dump()
+        res.member_rings = {
+            'member:%s' % (s.member,): s.trace.dump()
+            for s in ens.servers if s.trace is not None}
+        res.history = list(h.records)
+        res.member_events = h.member_timeline()
+        res.elections = sum(1 for r in h.records
+                            if r['kind'] == 'election')

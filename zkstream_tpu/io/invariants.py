@@ -66,10 +66,23 @@ failing seed's report reads without the source):
    :func:`check_multi_atomic` asserts no MULTI batch is ever
    partially visible — in the live tree or across a torn-record
    recovery (one CRC frame per batch).
+9. **Per-key linearizability** (analysis/linearize.py, the
+   concurrent tier — io/faults.py ``run_concurrent_schedule``) —
+   over the TWO-SIDED half of the history (:meth:`History.invoke` /
+   :meth:`History.settle` interval records), every key's operations
+   admit a Wing&Gong/Lowe-style linearization against the sequential
+   znode spec, MULTI batches atomic across their keys, ambiguity
+   rules exactly invariant 1's (an outcome-unknown op may linearize
+   as applied or be dropped).  Histories with no interval records —
+   every pre-concurrent-tier history — pass vacuously; the one-sided
+   recorders below stay as the degenerate interval (invocation and
+   response at the same history point), so invariants 1-8 run
+   unchanged on old and new histories alike.
 
 The history is plain data (a list of dicts) so it can ride a JSON
 trace dump next to the span ring; :func:`format_history` renders the
-member-event timeline for failure reports.
+member-event timeline for failure reports (``columns=True`` renders
+the per-client interleaving of the interval records instead).
 """
 
 from __future__ import annotations
@@ -90,6 +103,7 @@ class History:
 
     def __init__(self) -> None:
         self.records: list[dict] = []
+        self._next_call = 0
 
     def _add(self, kind: str, **fields) -> dict:
         rec = {'kind': kind, 't': len(self.records)}
@@ -98,6 +112,41 @@ class History:
         return rec
 
     # -- recorders --
+
+    def invoke(self, op: str, path: str | None, client: int = 0,
+               session_id: int = 0, data: bytes | None = None,
+               version: int | None = None,
+               subs: list | None = None) -> int:
+        """Open one two-sided interval: the op is about to be SENT.
+        Returns the call id :meth:`settle` closes the interval with.
+        ``op`` is one of create/set/delete/get/exists/multi; ``subs``
+        (multi only) is ``[(op, path, data, version)]``.  The interval
+        pair is what invariant 9 (analysis/linearize.py) searches —
+        an invoke with no settle is treated as outcome-unknown."""
+        call = self._next_call
+        self._next_call += 1
+        self._add('invoke', call=call, op=op, path=path,
+                  client=client, session_id=session_id, data=data,
+                  version=version,
+                  subs=list(subs) if subs is not None else None)
+        return call
+
+    def settle(self, call: int, status: str,
+               zxid: int | None = None, data: bytes | None = None,
+               version: int | None = None,
+               error: str | None = None) -> dict:
+        """Close the interval opened by :meth:`invoke`.  ``status``:
+        ``'ok'`` (applied; ``zxid``/``data``/``version`` carry what
+        the reply showed — reads record their observed payload here),
+        ``'error'`` (a definite spec verdict: NO_NODE / NODE_EXISTS /
+        BAD_VERSION — the op linearizes as a no-effect op yielding
+        exactly that error), ``'fail'`` (definitely never applied —
+        raised before send, or a typed fencing bounce; excluded from
+        the search), or ``'unknown'`` (outcome-unknown: may linearize
+        as applied or be dropped, invariant 1's ambiguity rule)."""
+        return self._add('settle', call=call, status=status,
+                         zxid=zxid, data=data, version=version,
+                         error=error)
 
     def op(self, op: str, path: str | None, status: str,
            zxid: int | None = None, session_id: int = 0,
@@ -549,6 +598,8 @@ def check_election(history: History) -> list[str]:
 def check_history(history: History, db) -> list[str]:
     """Run every invariant against the history and the leader's
     final database; returns the combined violation list."""
+    from ..analysis.linearize import check_linearizable
+
     out: list[str] = []
     out.extend(check_acked_durability(history, db))
     out.extend(check_zxid_monotonic(history))
@@ -557,17 +608,29 @@ def check_history(history: History, db) -> list[str]:
     out.extend(check_watch_once(history))
     out.extend(check_election(history))
     out.extend(check_multi_atomic(history, db))
+    # invariant 9: per-key WGL linearizability over the interval
+    # records (vacuous on histories that carry none)
+    out.extend(check_linearizable(history, db))
     return out
 
 
 def format_history(history: 'History | list[dict]',
                    kinds=('member', 'session', 'election'),
-                   limit: int | None = None) -> str:
+                   limit: int | None = None,
+                   columns: bool = False) -> str:
     """Render the member-event (and session-edge) timeline for a
     failure report, oldest first.  Accepts a :class:`History` or a
-    plain record list (``ScheduleResult.history``)."""
+    plain record list (``ScheduleResult.history``).
+
+    ``columns=True`` renders the per-client interleaving instead:
+    one column per client id, invoke (``op>``) and settle (``<st``)
+    rows of the interval records in history order, member events in
+    a trailing column — the view a linearizability counterexample is
+    read against."""
     records = history.records if isinstance(history, History) \
         else history
+    if columns:
+        return _format_columns(records, limit=limit)
     rows = [r for r in records if r['kind'] in kinds]
     if limit is not None and len(rows) > limit:
         rows = rows[-limit:]
@@ -583,4 +646,57 @@ def format_history(history: 'History | list[dict]',
         else:
             lines.append('  t=%-4d session %016x %s'
                          % (r['t'], r['session_id'], r['event']))
+    return '\n'.join(lines)
+
+
+#: Column width of the per-client interleaving view.
+_COL_W = 22
+
+
+def _format_columns(records: list[dict],
+                    limit: int | None = None) -> str:
+    """The per-client column view behind ``format_history(...,
+    columns=True)``: each interval record renders in its client's
+    column (``set /k0 v=-1 >`` opening, ``< ok z=14`` closing,
+    correlated by the ``#call`` prefix), member events in a trailing
+    column, so concurrent overlap — the thing a linearizability
+    counterexample hinges on — is visible by eye."""
+    invokes = {r['call']: r for r in records
+               if r['kind'] == 'invoke'}
+    clients = sorted({r['client'] for r in invokes.values()})
+    col = {c: i for i, c in enumerate(clients)}
+    rows = [r for r in records
+            if r['kind'] in ('invoke', 'settle', 'member')]
+    if limit is not None and len(rows) > limit:
+        rows = rows[-limit:]
+    head = '  %-7s %s| member' \
+        % ('t', ''.join(('client %-2s' % (c,)).ljust(_COL_W)
+                        for c in clients))
+    lines = [head]
+    for r in rows:
+        cells = [' ' * _COL_W] * len(clients)
+        tail = ''
+        if r['kind'] == 'member':
+            tail = '%s %s' % (r['event'], r['member'])
+        else:
+            inv = invokes.get(r.get('call'))
+            if r['kind'] == 'invoke':
+                text = '#%d %s %s >' % (r['call'], r['op'],
+                                        r.get('path') or '*')
+                c = r['client']
+            else:
+                c = inv['client'] if inv is not None else None
+                text = '< #%d %s' % (r['call'], r['status'])
+                if r.get('zxid') is not None:
+                    text += ' z=%d' % (r['zxid'],)
+                if r.get('error'):
+                    text += ' %s' % (r['error'],)
+            if c in col:
+                cells[col[c]] = text[:_COL_W - 1].ljust(_COL_W)
+            else:
+                # a settle whose invoke record is missing (e.g.
+                # trimmed by ``limit``): never guess a column
+                tail = text
+        lines.append('  t=%-5d %s| %s'
+                     % (r['t'], ''.join(cells), tail))
     return '\n'.join(lines)

@@ -937,7 +937,8 @@ async def find_leader(members, min_epoch: int = 0,
 async def run_process_schedule(seed: int, ops: int = 6,
                                members: int = 3, elections: int = 2,
                                generations: int = 2,
-                               workdir: str | None = None):
+                               workdir: str | None = None,
+                               clients: int | None = None):
     """One seeded OS-process election schedule: spawn ``members``
     symmetric peer processes over per-member WAL dirs, drive a seeded
     workload THROUGH THE LEADER (quorum-commit makes its ack
@@ -950,17 +951,29 @@ async def run_process_schedule(seed: int, ops: int = 6,
     every acked write.  Invariant
     7 (at-most-one-leader-per-epoch, epoch monotonicity) is checked
     over the recorded history; violations carry the seed, rerunnable
-    via ``zkstream_tpu chaos --tier process --seed N``."""
+    via ``zkstream_tpu chaos --tier process --seed N``.
+
+    ``clients`` > 1 runs every workload phase as N CONCURRENT
+    clients contending on a small shared key set, each op recorded
+    as a two-sided interval (``History.invoke``/``settle``), and the
+    schedule ends with the per-key WGL linearizability pass
+    (analysis/linearize.py, invariant 9) pinned to the final key
+    states read back through the elected leader — the OS-process
+    half of the concurrent tier (``chaos --tier process --clients
+    N``)."""
     import random
     import tempfile
 
+    from ..analysis.linearize import check_linearizable
     from ..client import Client
-    from ..io.faults import ScheduleResult
-    from ..io.invariants import check_election, History
+    from ..io.faults import ScheduleResult, record_settle_error
+    from ..io.invariants import (AMBIGUOUS_CODES, History,
+                                 check_election)
     from ..protocol.errors import ZKError, ZKProtocolError
 
     rng = random.Random('proc/%d' % (seed,))
-    res = ScheduleResult(seed=seed, tier='process')
+    res = ScheduleResult(seed=seed, tier='process',
+                         clients=clients if clients else 1)
     h = History()
     root = workdir or tempfile.mkdtemp(prefix='zkproc-elect-')
     own_root = workdir is None
@@ -996,8 +1009,6 @@ async def run_process_schedule(seed: int, ops: int = 6,
         return c
 
     async def retrying(coro_fn, attempts=30, delay=0.25):
-        from ..io.invariants import AMBIGUOUS_CODES
-
         last = None
         for _ in range(attempts):
             try:
@@ -1049,6 +1060,65 @@ async def run_process_schedule(seed: int, ops: int = 6,
         finally:
             await c.close()
 
+    #: the concurrent phases' shared, contended key set
+    lin_keys = ('/lk0', '/lk1', '/lk2')
+
+    async def concurrent_workload(phase: int, leader_id: int) -> None:
+        """The ``clients`` > 1 workload phase: N concurrent clients
+        over :data:`lin_keys`, every op an interval record.  No
+        retry loop — a churn-felled attempt settles as its own
+        outcome-unknown interval, exactly what the checker models."""
+
+        async def one(ci: int) -> None:
+            c = await fresh_client(leader_id)
+            crng = random.Random('proc-client/%d/%d/%d'
+                                 % (seed, phase, ci))
+            spans = [None]
+            c.on_op = lambda span: spans.__setitem__(0, span)
+            try:
+                for i in range(ops):
+                    res.ops += 1
+                    kind = crng.choice(('create', 'set', 'set',
+                                        'get', 'get'))
+                    key = crng.choice(lin_keys)
+                    tag = b'p%d-c%d-%d' % (phase, ci, i)
+                    call = h.invoke(kind, key, client=ci,
+                                    data=tag if kind != 'get'
+                                    else None)
+                    try:
+                        if kind == 'create':
+                            await asyncio.wait_for(
+                                c.create(key, tag), 8)
+                            span = spans[0]
+                            h.settle(call, 'ok',
+                                     zxid=span.zxid
+                                     if span is not None else None)
+                            res.acked += 1
+                        elif kind == 'set':
+                            stat = await asyncio.wait_for(
+                                c.set(key, tag, version=-1), 8)
+                            h.settle(call, 'ok', zxid=stat.mzxid,
+                                     version=stat.version)
+                            res.acked += 1
+                        else:
+                            got, stat = await asyncio.wait_for(
+                                c.get(key), 8)
+                            h.settle(call, 'ok', zxid=stat.mzxid,
+                                     data=bytes(got),
+                                     version=stat.version)
+                    except (ZKError, ZKProtocolError) as e:
+                        record_settle_error(res, h, call, e)
+                    except (asyncio.TimeoutError, TimeoutError):
+                        h.settle(call, 'unknown',
+                                 error='HARD_BOUND')
+            finally:
+                await c.close()
+
+        await asyncio.gather(*(one(ci) for ci in range(clients)))
+
+    work = concurrent_workload if clients and clients > 1 \
+        else workload
+
     async def verify(leader_id: int, context: str) -> None:
         c = await fresh_client(leader_id)
         try:
@@ -1081,7 +1151,7 @@ async def run_process_schedule(seed: int, ops: int = 6,
 
         # -- elected-leader kill loop: >= `elections` forced ---------
         for round_no in range(elections):
-            await workload(round_no, leader_id)
+            await work(round_no, leader_id)
             victim = next(m for m in fleet
                           if m.member_id == leader_id)
             # leader-killed-after-ack: one marker write THROUGH THE
@@ -1110,7 +1180,7 @@ async def run_process_schedule(seed: int, ops: int = 6,
             await victim.wait_ready()
             h.member_event('restart', victim.member_id)
             await verify(leader_id, 'after election %d' % (round_no,))
-        await workload(elections, leader_id)
+        await work(elections, leader_id)
 
         # -- full-ensemble SIGKILL -> election from recovered WALs --
         for gen in range(generations):
@@ -1145,6 +1215,36 @@ async def run_process_schedule(seed: int, ops: int = 6,
             finally:
                 await c.close()
 
+        if clients and clients > 1:
+            # invariant 9 over the concurrent phases: every shared
+            # key's interval history must linearize, pinned to the
+            # final state read back through the elected leader (the
+            # writes survived generations of SIGKILL by now).  Only
+            # a definite verdict pins a key: NO_NODE = absent, data
+            # = present; a key whose read-back exhausted its retries
+            # (connection churn) is left OUT of the mapping, which
+            # check_linearizable treats as unconstrained — never as
+            # absent, which would fabricate a lost-update finding.
+            c = await fresh_client(leader_id)
+            finals: dict = {}
+            try:
+                try:
+                    await retrying(lambda: c.sync('/'))
+                except (ZKError, ZKProtocolError, OSError):
+                    pass               # a barrier, not an op
+                for key in lin_keys:
+                    try:
+                        got, _stat = await retrying(
+                            lambda k=key: c.get(k))
+                        finals[key] = bytes(got)
+                    except ZKError as e:
+                        if e.code == 'NO_NODE':
+                            finals[key] = None
+                    except (ZKProtocolError, OSError):
+                        pass               # unpinned, not absent
+            finally:
+                await c.close()
+            res.violations.extend(check_linearizable(h, finals))
         res.violations.extend(check_election(h))
         return res
     except (TimeoutError, asyncio.TimeoutError) as e:
@@ -1165,15 +1265,19 @@ async def run_process_schedule(seed: int, ops: int = 6,
 
 async def run_process_campaign(base_seed: int, schedules: int,
                                ops: int = 6, progress=None,
-                               elections: int | None = None):
+                               elections: int | None = None,
+                               clients: int | None = None):
     """Consecutive seeded process-tier schedules from ``base_seed``.
     ``elections`` overrides the per-schedule forced leader-kill count
-    (part of the rerun key, like the ensemble tier's flag)."""
+    and ``clients`` > 1 makes every workload phase concurrent with
+    the linearizability pass at the end (both part of the rerun key,
+    like the ensemble tier's flags)."""
     out = []
     for i in range(schedules):
         r = await run_process_schedule(
             base_seed + i, ops=ops,
-            elections=elections if elections is not None else 2)
+            elections=elections if elections is not None else 2,
+            clients=clients)
         out.append(r)
         if progress is not None:
             progress(r)
