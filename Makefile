@@ -10,6 +10,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
     chaos chaos-ensemble obs durability election linearize \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
+    bench-read \
     timeline coverage clean
 
 all: check test
@@ -127,6 +128,21 @@ bench-ingress: native
 # with --sessions/--watchers.
 bench-fanout:
 	$(PYTHON) bench.py --fanout
+
+# Read scale-out envelope (README "Read plane"): paired cells at
+# 1 vs 3 vs 5 read-serving members — the leader plus non-voting
+# OBSERVERS spawned as real OS processes (member_worker --observer)
+# so read capacity genuinely parallelizes — x 1k/10k raw-socket
+# sessions x read-heavy/mixed workloads.  Exact sign tests: read
+# throughput must be significantly HIGHER at 3 and 5 members than 1,
+# and write p50 NOT significantly worse with observers attached (the
+# write quorum never widens: observers don't vote).  Gate counters
+# (zk_read_zxid_gate_*) and tick-ledger phases scraped per cell.
+# Rounds via ZKSTREAM_BENCH_READ_ROUNDS, window via
+# ZKSTREAM_BENCH_READ_SECS; narrow with --sessions/--workloads.
+# Table in PROFILE.md "Read plane".
+bench-read:
+	$(PYTHON) bench.py --read
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), causal tracing (client spans + member rings + the

@@ -2268,6 +2268,271 @@ def bench_ingress() -> None:
             }), flush=True)
 
 
+#: `bench.py --read` (`make bench-read`): read-serving member counts
+#: (1 = the leader alone; 3/5 = leader + 2/4 OBSERVERS — non-voting
+#: read replicas, so the write quorum stays a single member across
+#: every cell and only read capacity varies), session sweep and
+#: workloads.  Members are REAL OS processes (server/election.py
+#: ProcMember + member_worker --observer): in-process members share
+#: one event loop and could never show read scale-out.
+READ_MEMBERS = (1, 3, 5)
+READ_SESSIONS = (1000, 10000)
+READ_WORKLOADS = ('read', 'mixed')
+READ_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           'tools', 'read_worker.py')
+
+
+async def _read_cell(members: int, sessions: int, workload: str,
+                     duration_s: float) -> dict:
+    """One read-plane cell: spawn 1 voter + (members-1) observer
+    processes, park ``sessions`` raw-socket read sessions across them
+    (reader worker processes, tools/read_worker.py), pipeline
+    GET_DATA for ``duration_s`` and sum the replies; the ``mixed``
+    workload concurrently drives sets through the leader and records
+    per-write latency.  Scrapes the zxid read-gate counters and the
+    leader's tick-ledger phase rows after the window."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.server.election import (
+        ProcMember,
+        _scrape_mntr,
+        allocate_ports,
+        find_leader,
+    )
+
+    import asyncio
+
+    root = tempfile.mkdtemp(prefix='zkbench-read-')
+    ports = allocate_ports(2 * members)
+    fleet = [ProcMember(i, os.path.join(root, 'm%d' % i),
+                        ports[2 * i], ports[2 * i + 1],
+                        observer=i > 0)
+             for i in range(members)]
+    procs: list = []
+    c = None
+    loop = asyncio.get_running_loop()
+    try:
+        for m in fleet:
+            m.spawn(fleet)
+        for m in fleet:
+            await m.wait_ready()
+        await find_leader(fleet, min_epoch=1)
+        # a generous session: at 10k sessions x 1 member the
+        # handshake storm can starve pings for seconds — the cell
+        # must still report its (honest, terrible) number
+        c = Client(servers=[('127.0.0.1', fleet[0].client_port)],
+                   shuffle_backends=False, session_timeout=120000,
+                   op_timeout=60000)
+        c.start()
+        await c.wait_connected(timeout=20)
+        await c.create('/bench', b'x' * 128)
+
+        nworkers = max(1, min(8, (os.cpu_count() or 2) - members))
+        per = sessions // nworkers
+        addrs = ','.join('127.0.0.1:%d' % (m.client_port,)
+                         for m in fleet)
+        for w in range(nworkers):
+            n = per + (sessions - per * nworkers if w == 0 else 0)
+            procs.append(subprocess.Popen(
+                [sys.executable, READ_WORKER, addrs, str(n),
+                 '%g' % (duration_s,)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True))
+        connected = 0
+        for p in procs:
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, p.stdout.readline), 180)
+            assert line.startswith('READY'), line
+            connected += int(line.split()[1])
+        t0 = loop.time()
+        for p in procs:
+            p.stdin.write('GO\n')
+            p.stdin.flush()
+        write_lat: list[float] = []
+        seq = 0
+        if workload == 'mixed':
+            while loop.time() - t0 < duration_s:
+                w0 = loop.time()
+                await c.set('/bench', b'y%07d' % (seq,) + b'x' * 120,
+                            version=-1)
+                write_lat.append((loop.time() - w0) * 1000.0)
+                seq += 1
+        outs = []
+        for p in procs:
+            line = await asyncio.wait_for(
+                loop.run_in_executor(None, p.stdout.readline),
+                duration_s + 120)
+            outs.append(json.loads(line))
+            p.wait()
+        reads = sum(o['reads'] for o in outs)
+        # quiet-phase write burst: the read window is over, so this
+        # isolates what ATTACHING OBSERVERS costs a write (replication
+        # pushes to N mirrors) from where the read load happened to
+        # land — the apples-to-apples series the write-p50 sign test
+        # compares across member counts
+        qlat: list[float] = []
+        for i in range(200):
+            w0 = loop.time()
+            await c.set('/bench', b'q%07d' % (i,) + b'x' * 120,
+                        version=-1)
+            qlat.append((loop.time() - w0) * 1000.0)
+        qlat.sort()
+        cell = {
+            'members': members, 'sessions': connected,
+            'workload': workload,
+            'read': {'ops_per_sec': round(reads / duration_s, 1)},
+            'reader_errors': sum(o['errors'] for o in outs),
+        }
+        if write_lat:
+            lat = sorted(write_lat)
+            cell['write'] = {
+                'ops_per_sec': round(len(lat) / duration_s, 1),
+                'p50_ms': round(lat[len(lat) // 2], 3),
+                'p99_ms': round(lat[min(len(lat) - 1,
+                                        int(len(lat) * 0.99))], 3),
+            }
+        cell['write_quiet'] = {
+            'p50_ms': round(qlat[len(qlat) // 2], 3),
+            'p99_ms': round(qlat[min(len(qlat) - 1,
+                                     int(len(qlat) * 0.99))], 3),
+        }
+        blocks = bounces = 0
+        for m in fleet:
+            try:
+                rows = await _scrape_mntr(m.client_port)
+            except (OSError, TimeoutError):
+                continue
+            blocks += int(rows.get('zk_read_zxid_gate_blocks', 0))
+            bounces += int(rows.get('zk_read_zxid_gate_bounces', 0))
+            if m is fleet[0]:
+                cell['tick_phases'] = {
+                    k.split('"')[1]: float(v)
+                    for k, v in rows.items()
+                    if k.startswith('zk_tick_phase_ms_p99')}
+        cell['gate'] = {'blocks': blocks, 'bounces': bounces}
+        return cell
+    finally:
+        if c is not None:
+            try:
+                await asyncio.wait_for(c.close(), 5)
+            except Exception:
+                c.pool.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            try:
+                p.stdout.close()
+                p.stdin.close()
+            except Exception:
+                pass
+        for m in fleet:
+            try:
+                m.kill()
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_read() -> None:
+    """The read scale-out envelope (`make bench-read`; README "Read
+    plane"): paired cells at 1 vs 3 vs 5 read-serving members (leader
+    + observers, real OS processes) x session sweep x read-heavy /
+    mixed workloads.  Acceptance: read throughput significantly
+    HIGHER at 3 and 5 members than 1 on the read-heavy cells (exact
+    sign test over per-round adjacent runs), and write p50 NOT
+    significantly worse with observers attached (the quorum never
+    widened: observers don't vote).  Rounds via
+    ZKSTREAM_BENCH_READ_ROUNDS; window via ZKSTREAM_BENCH_READ_SECS;
+    narrow with --sessions / --workloads.  Table in PROFILE.md "Read
+    plane"."""
+    import asyncio as aio
+
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_READ_ROUNDS', '8'))
+    duration = float(os.environ.get('ZKSTREAM_BENCH_READ_SECS',
+                                    '2.0'))
+    sessions_sweep = _arg_ints('--sessions') or list(READ_SESSIONS)
+    workloads = list(READ_WORKLOADS)
+    if '--workloads' in sys.argv:
+        idx = sys.argv.index('--workloads')
+        workloads = sys.argv[idx + 1].split(',')
+    env_sessions = os.environ.get('ZKSTREAM_BENCH_READ_SESSIONS')
+    if env_sessions:
+        sessions_sweep = [int(x) for x in env_sessions.split(',')]
+
+    reads: dict = {}
+    writes: dict = {}
+    cells: dict = {}
+    for _rnd in range(rounds):
+        for sessions in sessions_sweep:
+            for wl in workloads:
+                for n in READ_MEMBERS:
+                    key = (sessions, wl, n)
+                    try:
+                        r = aio.run(_read_cell(n, sessions, wl,
+                                               duration))
+                    except Exception as e:
+                        print('# read cell m=%d s=%d %s failed: %r'
+                              % (n, sessions, wl, e),
+                              file=sys.stderr)
+                        # placeholder keeps the per-round pairing
+                        # aligned: sign() drops pairs with a None
+                        reads.setdefault(key, []).append(None)
+                        writes.setdefault(key, []).append(None)
+                        continue
+                    reads.setdefault(key, []).append(
+                        r['read']['ops_per_sec'])
+                    writes.setdefault(key, []).append(
+                        r['write_quiet']['p50_ms'])
+                    if key not in cells or r['read']['ops_per_sec'] \
+                            > cells[key]['read']['ops_per_sec']:
+                        cells[key] = r
+    for key in sorted(cells):
+        print('# read_cell %s' % (json.dumps(cells[key]),),
+              file=sys.stderr)
+
+    def sign(metric: str, rows: dict, sessions: int, wl: str,
+             n: int, higher_is_better: bool) -> None:
+        a = rows.get((sessions, wl, n), [])
+        b = rows.get((sessions, wl, 1), [])
+        paired = [(x, y) for x, y in zip(a, b)
+                  if x is not None and y is not None]
+        if not paired:
+            return
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired
+                   if (x > y) == higher_is_better and x != y)
+        losses = sum(1 for x, y in paired
+                     if (x > y) != higher_is_better and x != y)
+        print(json.dumps({
+            'metric': metric,
+            'pair': '%d-vs-1' % (n,),
+            'sessions': sessions,
+            'workload': wl,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+    for sessions in sessions_sweep:
+        for wl in workloads:
+            for n in READ_MEMBERS[1:]:
+                sign('read_scaleout_sign_test', reads, sessions, wl,
+                     n, higher_is_better=True)
+                # quiet-phase write p50: LOWER is better; the bar
+                # is "not significantly worse with observers
+                # attached" (the quorum never widened)
+                sign('read_write_p50_sign_test', writes,
+                     sessions, wl, n, higher_is_better=False)
+
+
 def _guard_backend(timeout_s: float | None = None) -> None:
     """Probe the default JAX backend in a SUBPROCESS before this
     process touches jax: a wedged tunneled-TPU backend has been
@@ -2389,6 +2654,15 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_fanout()
+        return
+    if '--read' in sys.argv:
+        # `make bench-read`: the read scale-out cell family (README
+        # "Read plane": 1 vs 3 vs 5 read-serving members as real OS
+        # processes — leader + non-voting observers).  Host-path
+        # only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_read()
         return
     if '--write' in sys.argv:
         # `make bench-write`: the write-heavy client-ops cell family

@@ -77,6 +77,9 @@ static PyObject *s_xid, *s_zxid, *s_err, *s_opcode, *s_data, *s_stat,
 static PyObject *s_notification, *s_ping, *s_auth, *s_set_watches, *s_ok;
 static PyObject *s_dataChanged, *s_createdOrDestroyed,
     *s_childrenChanged;
+/* MULTI (opcode 14) framing: result/ops keys + sub-op names */
+static PyObject *s_results, *s_op, *s_ops, *s_op_create, *s_op_delete,
+    *s_op_set_data, *s_op_check, *s_op_error;
 /* attribute names for ACL entries (records.ACL / records.Id) */
 static PyObject *s_perms, *s_scheme, *s_id_attr;
 
@@ -90,6 +93,7 @@ enum {
   LAYOUT_GET_DATA = 5,
   LAYOUT_STAT_ONLY = 6,
   LAYOUT_NOTIFICATION = 7,
+  LAYOUT_MULTI = 8,
 };
 
 /* request-body layouts (server direction) — g_req_opcodes values */
@@ -101,6 +105,7 @@ enum {
   RQ_DELETE = 4,
   RQ_SET_DATA = 5,
   RQ_SET_WATCHES = 6,
+  RQ_MULTI = 7,
 };
 
 /* ---- byte readers (big-endian, bounds-checked) ---- */
@@ -111,8 +116,9 @@ typedef struct {
   Py_ssize_t off;
   char err[192]; /* non-empty => decode error */
   int unsupported; /* protocol-valid opcode this tier has no layout
-                    * for (e.g. MULTI): the frame is left in the
-                    * buffer and the Python spec tier decodes it */
+                    * for (none today — MULTI landed in abi 9): the
+                    * frame is left in the buffer and the Python
+                    * spec tier decodes it */
 } Cursor;
 
 static int need(Cursor *c, Py_ssize_t n) {
@@ -354,6 +360,67 @@ static int decode_body(Cursor *c, PyObject *pkt, int layout) {
       if (PyDict_SetItem(pkt, s_state, sname) < 0) return -1;
       return set_steal(pkt, s_path, rd_string(c));
     }
+    case LAYOUT_MULTI: {
+      /* jute MultiResponse (opcode 14): `int type | bool done | int
+       * err` headers, OK results carrying the single-op reply body
+       * (create: path; setData: Stat; delete/check: header only),
+       * type -1 an ErrorResult whose body repeats the code,
+       * terminated by a done header.  Mirrors
+       * records._read_multi_resp exactly (which, like the upstream
+       * client, does not re-check the terminator's type). */
+      PyObject *results = PyList_New(0);
+      if (results == NULL) return -1;
+      for (;;) {
+        if (!need(c, 9)) goto multi_fail;
+        int32_t mtype = rd_i32(c);
+        int done = rd_bool(c);
+        if (done < 0) goto multi_fail;
+        int32_t errv = rd_i32(c);
+        if (done) break;
+        PyObject *res = PyDict_New();
+        if (res == NULL) goto multi_fail;
+        int bad = 0;
+        if (mtype == -1) {
+          if (!need(c, 4)) {
+            Py_DECREF(res);
+            goto multi_fail;
+          }
+          (void)rd_i32(c);    /* ErrorResult body repeats the code */
+          bad |= PyDict_SetItem(res, s_op, s_op_error) < 0;
+          PyObject *en = int_key_get(g_err_names, errv);
+          if (en != NULL) {   /* borrowed */
+            bad |= PyDict_SetItem(res, s_err, en) < 0;
+          } else {            /* consts.err_name's ERROR_%d shape */
+            bad |= set_steal(res, s_err,
+                             PyUnicode_FromFormat("ERROR_%d",
+                                                  errv)) < 0;
+          }
+        } else if (mtype == 1) {           /* OpCode.CREATE */
+          bad |= PyDict_SetItem(res, s_op, s_op_create) < 0;
+          bad |= set_steal(res, s_path, rd_string(c)) < 0;
+        } else if (mtype == 5) {           /* OpCode.SET_DATA */
+          bad |= PyDict_SetItem(res, s_op, s_op_set_data) < 0;
+          bad |= set_steal(res, s_stat, rd_stat(c)) < 0;
+        } else if (mtype == 2) {           /* OpCode.DELETE */
+          bad |= PyDict_SetItem(res, s_op, s_op_delete) < 0;
+        } else if (mtype == 13) {          /* OpCode.CHECK */
+          bad |= PyDict_SetItem(res, s_op, s_op_check) < 0;
+        } else {
+          snprintf(c->err, sizeof(c->err),
+                   "unsupported multi result type %d", mtype);
+          bad = 1;
+        }
+        if (bad || PyList_Append(results, res) < 0) {
+          Py_DECREF(res);
+          goto multi_fail;
+        }
+        Py_DECREF(res);
+      }
+      return set_steal(pkt, s_results, results);
+    multi_fail:
+      Py_DECREF(results);
+      return -1;
+    }
     default:
       snprintf(c->err, sizeof(c->err), "unknown layout %d", layout);
       return -1;
@@ -394,9 +461,9 @@ static PyObject *decode_reply(Cursor *c, PyObject *xid_map) {
       Py_INCREF(opcode);
       opcode_owned = 1;
       /* punt BEFORE consuming the xid: a reply opcode this tier has
-       * no body layout for (MULTI) goes back to the Python spec,
-       * which pops the xid itself.  Error replies carry no body, so
-       * they stay decodable here whatever the opcode. */
+       * no body layout for (none registered today) goes back to the
+       * Python spec, which pops the xid itself.  Error replies carry
+       * no body, so they stay decodable here whatever the opcode. */
       if (errc == 0) {
         PyObject *layout = PyDict_GetItemWithError(g_layouts, opcode);
         if (layout == NULL) {
@@ -520,6 +587,62 @@ static int decode_req_body(Cursor *c, PyObject *pkt, int layout) {
       }
       return set_steal(pkt, s_events, events);
     }
+    case RQ_MULTI: {
+      /* jute MultiTransactionRecord (opcode 14): headers as in the
+       * response direction; sub-op bodies reuse the single-op
+       * request layouts (create/delete/setData; check shares
+       * delete's path+version shape), and the terminator's type
+       * must be -1 — mirrors records._read_multi exactly. */
+      PyObject *ops = PyList_New(0);
+      if (ops == NULL) return -1;
+      for (;;) {
+        if (!need(c, 9)) goto rq_multi_fail;
+        int32_t mtype = rd_i32(c);
+        int done = rd_bool(c);
+        if (done < 0) goto rq_multi_fail;
+        (void)rd_i32(c);                  /* err: always -1 here */
+        if (done) {
+          if (mtype != -1) {
+            snprintf(c->err, sizeof(c->err),
+                     "multi terminator carries type %d", mtype);
+            goto rq_multi_fail;
+          }
+          break;
+        }
+        PyObject *name;
+        int sublayout;
+        if (mtype == 1) {                  /* OpCode.CREATE */
+          name = s_op_create;
+          sublayout = RQ_CREATE;
+        } else if (mtype == 2) {           /* OpCode.DELETE */
+          name = s_op_delete;
+          sublayout = RQ_DELETE;
+        } else if (mtype == 5) {           /* OpCode.SET_DATA */
+          name = s_op_set_data;
+          sublayout = RQ_SET_DATA;
+        } else if (mtype == 13) {          /* OpCode.CHECK */
+          name = s_op_check;
+          sublayout = RQ_DELETE;   /* same path+version body */
+        } else {
+          snprintf(c->err, sizeof(c->err),
+                   "unsupported multi sub-op type %d", mtype);
+          goto rq_multi_fail;
+        }
+        PyObject *sub = PyDict_New();
+        if (sub == NULL) goto rq_multi_fail;
+        if (PyDict_SetItem(sub, s_op, name) < 0 ||
+            decode_req_body(c, sub, sublayout) < 0 ||
+            PyList_Append(ops, sub) < 0) {
+          Py_DECREF(sub);
+          goto rq_multi_fail;
+        }
+        Py_DECREF(sub);
+      }
+      return set_steal(pkt, s_ops, ops);
+    rq_multi_fail:
+      Py_DECREF(ops);
+      return -1;
+    }
     default:
       snprintf(c->err, sizeof(c->err), "unknown request layout %d",
                layout);
@@ -537,8 +660,8 @@ static PyObject *decode_request(Cursor *c) {
     /* match the Python spec's two distinct failures: a protocol-valid
      * opcode with no request reader vs a number outside the enum.  A
      * valid opcode is a PUNT, not an error: the spec tier may carry a
-     * reader this tier does not (MULTI) — the driver leaves the frame
-     * in the buffer and the Python path decides. */
+     * reader this tier does not — the driver leaves the frame in the
+     * buffer and the Python path decides. */
     PyObject *known = int_key_get(g_op_names, op);
     if (known != NULL) {
       snprintf(c->err, sizeof(c->err), "unsupported opcode '%s'",
@@ -1120,7 +1243,7 @@ static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
 }
 
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(8);
+  return PyLong_FromLong(9);
 }
 
 /* CRC32C (Castagnoli, reflected 0x82F63B78) for the write-ahead-log
@@ -2030,6 +2153,14 @@ PyMODINIT_FUNC PyInit__zkwire_ext(void) {
   s_createdOrDestroyed =
       PyUnicode_InternFromString("createdOrDestroyed");
   s_childrenChanged = PyUnicode_InternFromString("childrenChanged");
+  s_results = PyUnicode_InternFromString("results");
+  s_op = PyUnicode_InternFromString("op");
+  s_ops = PyUnicode_InternFromString("ops");
+  s_op_create = PyUnicode_InternFromString("create");
+  s_op_delete = PyUnicode_InternFromString("delete");
+  s_op_set_data = PyUnicode_InternFromString("set_data");
+  s_op_check = PyUnicode_InternFromString("check");
+  s_op_error = PyUnicode_InternFromString("error");
   s_perms = PyUnicode_InternFromString("perms");
   s_scheme = PyUnicode_InternFromString("scheme");
   s_id_attr = PyUnicode_InternFromString("id");

@@ -3,8 +3,9 @@
 Three layers: the store's atomic apply (speculative-with-undo —
 rollback leaves the tree, the session ephemeral sets, the sequential
 counters and the zxid byte-identical to never having applied, and no
-watch fires), the wire round trip through the real server (codec
-tiers incl. the C-extension punt, Client.multi / transaction), and
+watch fires), the wire round trip through the real server (every codec
+tier — the C extension now carries MULTI layouts in both directions,
+A/B-held byte-identical below — Client.multi / transaction), and
 the replication story (ONE log entry per batch, forwarded MULTI
 through a cross-process follower's mirror).
 """
@@ -275,3 +276,106 @@ async def test_multi_survives_wal_restart(tmp_path):
     finally:
         await c.close()
         await srv.stop()
+
+
+# -- C-extension decode layouts (the PR 12 carry, closed) --------------
+#
+# MULTI used to be the one opcode the C tier PUNTED per frame back to
+# the Python spec decoder.  Both directions now carry a C layout
+# (native/zkwire_ext.c LAYOUT_MULTI / RQ_MULTI); these A/B cells hold
+# the two tiers byte-identical — same packet dicts from the same wire
+# bytes, xid bookkeeping included — so the layouts can never drift
+# from records._read_multi / _read_multi_resp.
+
+def _codec_pair(server: bool):
+    from zkstream_tpu.protocol.framing import PacketCodec
+
+    py = PacketCodec(server=server, use_native=False)
+    cx = PacketCodec(server=server, use_native=True)
+    py.handshaking = cx.handshaking = False
+    return py, cx
+
+
+def test_ext_decodes_multi_request_ab():
+    from zkstream_tpu.protocol.framing import PacketCodec
+    from zkstream_tpu.protocol.records import OPEN_ACL_UNSAFE
+    from zkstream_tpu.utils import native
+
+    if native.ensure_ext() is None:
+        pytest.skip('no C toolchain for the extension')
+    enc = PacketCodec(server=False, use_native=False)
+    enc.handshaking = False
+    wire = enc.encode({'opcode': 'MULTI', 'xid': 11, 'ops': [
+        {'op': 'create', 'path': '/a', 'data': b'x',
+         'acl': list(OPEN_ACL_UNSAFE), 'flags': 0},
+        {'op': 'set_data', 'path': '/b', 'data': b'y' * 100,
+         'version': 3},
+        {'op': 'delete', 'path': '/c', 'version': -1},
+        {'op': 'check', 'path': '/d', 'version': 5},
+    ]})
+    py, cx = _codec_pair(server=True)
+    a, b = py.decode(wire), cx.decode(wire)
+    assert a == b
+    assert b[0]['opcode'] == 'MULTI'
+    assert [s['op'] for s in b[0]['ops']] == [
+        'create', 'set_data', 'delete', 'check']
+    # the sub-op dicts carry the exact single-op reader shapes
+    assert b[0]['ops'][0]['flags'] == CreateFlag(0)
+    assert isinstance(b[0]['ops'][0]['flags'], CreateFlag)
+
+
+def test_ext_decodes_multi_response_ab():
+    from zkstream_tpu.protocol.framing import PacketCodec
+    from zkstream_tpu.protocol.records import Stat
+    from zkstream_tpu.utils import native
+
+    if native.ensure_ext() is None:
+        pytest.skip('no C toolchain for the extension')
+    senc = PacketCodec(server=True, use_native=False)
+    senc.handshaking = False
+    stat = Stat(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+    ok_wire = senc.encode({
+        'opcode': 'MULTI', 'xid': 7, 'zxid': 99, 'err': 'OK',
+        'results': [{'op': 'create', 'path': '/a'},
+                    {'op': 'set_data', 'stat': stat},
+                    {'op': 'delete'}, {'op': 'check'}]})
+    err_wire = senc.encode({
+        'opcode': 'MULTI', 'xid': 8, 'zxid': 100, 'err': 'OK',
+        'results': [{'op': 'error', 'err': 'NO_NODE'},
+                    {'op': 'error',
+                     'err': 'RUNTIME_INCONSISTENCY'}]})
+    py, cx = _codec_pair(server=False)
+    for codec in (py, cx):
+        codec.xid_map[7] = 'MULTI'
+        codec.xid_map[8] = 'MULTI'
+    a, b = py.decode(ok_wire + err_wire), cx.decode(ok_wire + err_wire)
+    assert a == b
+    assert b[0]['results'][1]['stat'] == stat
+    assert b[1]['results'][0] == {'op': 'error', 'err': 'NO_NODE'}
+    # one reply per xid, popped by BOTH tiers
+    assert not py.xid_map and not cx.xid_map
+
+
+def test_ext_multi_bad_frames_match_spec_errors():
+    """Corrupt MULTI frames fail identically on both tiers (same
+    BAD_DECODE classification, no partial packet surfaced)."""
+    from zkstream_tpu.protocol.errors import ZKProtocolError
+    from zkstream_tpu.protocol.framing import PacketCodec
+    from zkstream_tpu.utils import native
+
+    if native.ensure_ext() is None:
+        pytest.skip('no C toolchain for the extension')
+    enc = PacketCodec(server=False, use_native=False)
+    enc.handshaking = False
+    wire = bytearray(enc.encode({'opcode': 'MULTI', 'xid': 3, 'ops': [
+        {'op': 'check', 'path': '/d', 'version': 5}]}))
+    # corrupt the terminator's type (-1 -> -2): the spec reader
+    # raises 'multi terminator carries type', and so must the C tier
+    term = wire.rindex(b'\xff\xff\xff\xff\x01')
+    wire[term:term + 4] = b'\xff\xff\xff\xfe'
+    for use_native in (False, True):
+        codec = PacketCodec(server=True, use_native=use_native)
+        codec.handshaking = False
+        with pytest.raises(ZKProtocolError) as ei:
+            codec.decode(bytes(wire))
+        assert ei.value.code == 'BAD_DECODE'

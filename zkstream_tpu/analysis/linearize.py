@@ -32,10 +32,11 @@ ensemble implements today (README "Ensemble failover matrix"):
   strict sub-batch: sub-zxids are interior points no member state
   ever shows).  :func:`check_session_reads` layers the last rung —
   a session never observes state older than it has already seen —
-  as a SEPARATE checker: today's pool migrates sessions onto
-  lagging followers without a zxid read gate, so that rung is
-  exactly what the read scale-out plane (ROADMAP: observer members
-  + session-consistent follower reads) must switch on and pass.
+  held since PR 15 by the zxid read gate (server/server.py
+  ReadGate + the client read plane's header-zxid validation) and
+  wired into ``check_history`` on both chaos tiers; the env-gated
+  ungated path (``ZKSTREAM_NO_READ_GATE=1``) is the validator this
+  rung exists to catch.
 - **ambiguity** follows invariant 1 exactly: a call whose outcome is
   unknown (CONNECTION_LOSS / deadline / never settled) may linearize
   as applied at any point after its invocation, or be dropped
@@ -53,7 +54,8 @@ Entry points: :func:`check_linearizable` (wired into
 interval records), :func:`check_recovered_prefix` (the durability
 composition: the crash-recovered tree must equal the zxid-ordered
 replay prefix) and :func:`check_session_reads` (the read-plane
-gate, not yet wired — see above).  Rerun any failing seed with
+gate, wired into ``check_history`` and the process tier's
+concurrent pass since PR 15).  Rerun any failing seed with
 ``python -m zkstream_tpu chaos --tier ensemble --clients N --seed
 S``.
 """
@@ -647,12 +649,15 @@ def _check_reads(ops: list[IntervalOp]) -> list[str]:
 
 
 def check_session_reads(history) -> list[str]:
-    """The read-plane gate (NOT wired into ``check_history`` yet):
-    a session never observes state older than what it has already
-    seen.  Today the pool migrates sessions onto lagging followers
-    with no zxid read gate, so chaos schedules legitimately violate
-    this; the read scale-out plane (ROADMAP: observer members +
-    session-consistent follower reads) must turn it on and hold it.
+    """The read-plane gate, wired into ``check_history`` (PR 15): a
+    session never observes state older than what it has already
+    seen.  The pool migrates sessions onto lagging followers and
+    observers, and the zxid read gate (server/server.py ReadGate:
+    every session carries a last-seen-zxid floor, a read on a member
+    behind it blocks briefly or bounces; the client read plane adds
+    a header-zxid validation on distributed reads) is what holds
+    this rung; ``ZKSTREAM_NO_READ_GATE=1`` is the env-gated ungated
+    validator this checker exists to catch.
 
     Per client, in completion order, a floor tracks the newest
     member state the session provably saw (write reply zxids, read

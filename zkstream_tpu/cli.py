@@ -394,6 +394,19 @@ def build_parser() -> argparse.ArgumentParser:
                          'Part of the rerun key: seed + this flag '
                          'reproduce the schedule exactly.  Default: '
                          '1 (the classic single-client workload)')
+    ch.add_argument('--observers', type=int, default=None,
+                    help='ensemble/process tiers: attach N '
+                         'non-voting observer members (the read '
+                         'plane, README "Read plane") — clients run '
+                         'with read distribution on, the observer '
+                         'lag/partition fault vocabulary draws from '
+                         'its own RNG stream, and the newly wired '
+                         'session-monotone read check '
+                         '(analysis/linearize.py '
+                         'check_session_reads) is the invariant '
+                         'under test.  Part of the rerun key like '
+                         '--clients.  Default: drawn per seed '
+                         '(ensemble tier) / 0 (process tier)')
     ch.add_argument('--elections', type=int, default=None,
                     help='ensemble tier: force N leader elections '
                          'per schedule (kill the current leader at '
@@ -561,7 +574,8 @@ async def _chaos(args) -> int:
             ops=args.ops if args.ops is not None else 12,
             progress=progress,
             elections=getattr(args, 'elections', None),
-            clients=getattr(args, 'clients', None))
+            clients=getattr(args, 'clients', None),
+            observers=getattr(args, 'observers', None))
     elif args.tier == 'process':
         if getattr(args, 'no_election', False):
             # the process tier IS the election plane: there is no
@@ -576,11 +590,17 @@ async def _chaos(args) -> int:
             ops=args.ops if args.ops is not None else 6,
             progress=progress,
             elections=getattr(args, 'elections', None),
-            clients=getattr(args, 'clients', None))
+            clients=getattr(args, 'clients', None),
+            observers=getattr(args, 'observers', None))
     else:
         if getattr(args, 'clients', None) and args.clients > 1:
             print('error: --clients needs the history-checked '
                   'tiers; use --tier ensemble or --tier process',
+                  file=sys.stderr)
+            return 2
+        if getattr(args, 'observers', None):
+            print('error: --observers needs an ensemble; use '
+                  '--tier ensemble or --tier process',
                   file=sys.stderr)
             return 2
         results = await run_campaign(
@@ -616,11 +636,14 @@ async def _chaos(args) -> int:
              sum(r.deadline_errors for r in results)))
     if bad:
         clients = getattr(args, 'clients', None)
+        observers = getattr(args, 'observers', None)
         print('failing seeds (rerun: python -m zkstream_tpu chaos '
-              '--tier %s%s --seed N --schedules 1): %s'
+              '--tier %s%s%s --seed N --schedules 1): %s'
               % (args.tier,
                  ' --clients %d' % (clients,)
                  if clients and clients > 1 else '',
+                 ' --observers %d' % (observers,)
+                 if observers else '',
                  ', '.join(str(r.seed) for r in bad)),
               file=sys.stderr)
         return 1

@@ -33,6 +33,8 @@ from .io.pool import (
     DEFAULT_DECOHERENCE_INTERVAL,
     DEFAULT_POLICY,
     ConnectionPool,
+    ReadPlane,
+    read_distribution_default,
 )
 from .io.session import ZKSession
 from .io.watcher import ZKWatcher
@@ -84,7 +86,8 @@ class Client(FSM):
                  trace_capacity: int = 256,
                  cork: bool | None = None,
                  transport: str | None = None,
-                 flush_cap: int | None = None):
+                 flush_cap: int | None = None,
+                 read_distribution: bool | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -179,6 +182,23 @@ class Client(FSM):
             decoherence_interval=decoherence_interval,
             shuffle=shuffle_backends, seed=seed,
             max_spares=max_spares)
+
+        #: Client-side read scale-out (README "Read plane"): with
+        #: more than one backend, get/exists/getACL/list fan out over
+        #: per-backend read sessions while writes, watches and sync
+        #: stay on the primary session — zxid-gated so the session
+        #: view never goes backwards (io/pool.py ReadPlane).  None =
+        #: process default (``ZKSTREAM_READ_DISTRIBUTION=1`` enables).
+        enabled_reads = (read_distribution_default()
+                         if read_distribution is None
+                         else read_distribution)
+        self._read_plane = (ReadPlane(self, backends)
+                            if enabled_reads and len(backends) > 1
+                            else None)
+        #: The newest member zxid any DISTRIBUTED read has shown this
+        #: client (the primary session's own floor lives in
+        #: ``session.last_zxid``); :meth:`last_seen_zxid` is the max.
+        self._read_floor = 0
         self.pool.on('stateChanged', self._on_pool_state_changed)
         # Degraded-mode surface: re-emit the pool's circuit-breaker
         # edges on the client, count them, and expose the current state
@@ -246,6 +266,8 @@ class Client(FSM):
         assert not self._started, 'client already started'
         self._started = True
         self.pool.start()
+        if self._read_plane is not None:
+            self._read_plane.start()
 
     async def close(self) -> None:
         """Close the session cleanly and stop the pool."""
@@ -256,6 +278,8 @@ class Client(FSM):
         self.once('close', lambda: fut.done() or fut.set_result(None))
         self.emit('closeAsserted')
         await fut
+        if self._read_plane is not None:
+            await self._read_plane.close()
         if self.transport_tier is not None:
             # release the tier's ring fd with the client instead of
             # waiting on cyclic GC (the plane/entry closures keep the
@@ -270,6 +294,21 @@ class Client(FSM):
         s = ZKSession(self.session_timeout, self.collector, log=self.log,
                       retry_policy=self._retry_policy, seed=self._seed,
                       trace=self.trace)
+        prev = self.session
+        carried = max(
+            (prev.last_zxid if prev is not None else 0),
+            (prev.gate_floor if prev is not None else 0),
+            self._read_floor)
+        if carried > s.gate_floor:
+            # client-level floor carry: a REPLACEMENT session (the old
+            # one expired) must not read below what this client has
+            # already observed — on ANY of its connections, the read
+            # plane's included.  The handshake presents the floor as
+            # lastZxidSeen, seeding the server-side zxid read gate
+            # (server/server.py ReadGate); it rides gate_floor, not
+            # last_zxid, so SET_WATCHES relZxid semantics are
+            # untouched.
+            s.gate_floor = carried
         s.fatal_handler = self.on_fatal
         self.session = s
 
@@ -495,6 +534,100 @@ class Client(FSM):
             if self.on_op is not None and span is not None:
                 self.on_op(span)
 
+    # -- the read plane (README "Read plane") --
+
+    def last_seen_zxid(self) -> int:
+        """The newest member zxid this client has provably observed,
+        across the primary session (write acks, reads, notifications
+        — io/session.py tracks every reply header) and the read
+        plane's distributed replies.  The client-side zxid gate
+        compares every distributed read's reply header against it."""
+        sess = self.session
+        sess_z = 0 if sess is None else max(sess.last_zxid,
+                                            sess.gate_floor)
+        return max(sess_z, self._read_floor)
+
+    async def _primary_request(self, pkt: dict, opcode: str,
+                               path: str | None, deadline) -> dict:
+        """One request on the primary connection (the legacy path):
+        returns the full reply packet."""
+        conn = self._conn_or_raise()
+        fut, span = self._start_op(conn, pkt)
+        return await self._await_op(fut, opcode, path, deadline, span)
+
+    def _note_read_floor(self, zxid: int) -> None:
+        """A distributed read showed the client member state at
+        ``zxid``: raise the client floor AND the session's gate
+        floor, so the next handshake (migration, replacement) seeds
+        the server-side ReadGate with everything this client has
+        seen — on any of its connections."""
+        if zxid > self._read_floor:
+            self._read_floor = zxid
+        sess = self.session
+        if sess is not None and zxid > sess.gate_floor:
+            sess.gate_floor = zxid
+
+    async def _read_request(self, pkt: dict, opcode: str,
+                            path: str | None, deadline) -> dict:
+        """Route one read: through the read plane when enabled —
+        zxid-gated, so a reply from a member behind this client's
+        floor (re-checked at REPLY time: a write acked while the
+        read was in flight raises it) is DISCARDED and the read
+        re-issued on the primary connection (never surfaced stale) —
+        else the primary.  Any read-session failure (typed error,
+        deadline, not-connected) also falls back to the primary: the
+        distributed path may add a retry's latency, never a new
+        failure mode.  The primary fallback is floor-guarded too:
+        when its member trails what the plane already showed this
+        client (possible inside one connection — the handshake seed
+        only covers floors known at attach time), a ``sync`` barrier
+        catches the member up and the read re-issues once."""
+        plane = self._read_plane
+        if plane is not None and plane.started:
+            primary = self.pool.current_backend()
+            sub = plane.pick(primary.key if primary is not None
+                             else None)
+            if sub is not None:
+                try:
+                    out = await sub._primary_request(
+                        dict(pkt), opcode, path, deadline)
+                except (ZKNotConnectedError, ZKDeadlineError):
+                    plane.fallbacks += 1
+                except Exception as e:
+                    from .protocol.errors import (
+                        ZKError,
+                        ZKProtocolError,
+                    )
+                    if not isinstance(e, (ZKError, ZKProtocolError,
+                                          OSError)):
+                        raise
+                    # a spec verdict off a possibly-stale member
+                    # (error replies carry no state to gate on) or
+                    # connection churn: the primary's answer is the
+                    # contract
+                    plane.fallbacks += 1
+                else:
+                    if out.get('zxid', 0) >= self.last_seen_zxid():
+                        plane.distributed += 1
+                        self._note_read_floor(out['zxid'])
+                        return out
+                    plane.bounced += 1   # stale member: never surface
+        out = await self._primary_request(pkt, opcode, path, deadline)
+        if plane is not None \
+                and out.get('zxid', 0) < self._read_floor \
+                and path is not None:
+            # the primary's member trails the plane's floor: sync is
+            # the bounded barrier (the member applies everything the
+            # leader committed — which includes every zxid any member
+            # ever showed this client), then the read re-issues fresh
+            plane.bounced += 1
+            await self._primary_request(
+                {'opcode': 'SYNC', 'path': path}, 'SYNC', path,
+                deadline)
+            out = await self._primary_request(pkt, opcode, path,
+                                              deadline)
+        return out
+
     async def ping(self, deadline=_USE_DEFAULT) -> float:
         """Round-trip a ping; resolves to the latency in ms."""
         conn = self._conn_or_raise()
@@ -528,21 +661,17 @@ class Client(FSM):
                    deadline=_USE_DEFAULT) -> tuple[list[str], Stat]:
         """Children of a znode, with its stat."""
         self._check_path(path)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'GET_CHILDREN2',
-                                          'path': path, 'watch': False})
-        pkt = await self._await_op(fut, 'GET_CHILDREN2', path, deadline,
-                                   span)
+        pkt = await self._read_request(
+            {'opcode': 'GET_CHILDREN2', 'path': path, 'watch': False},
+            'GET_CHILDREN2', path, deadline)
         return pkt['children'], pkt['stat']
 
     async def get(self, path: str,
                   deadline=_USE_DEFAULT) -> tuple[bytes, Stat]:
         self._check_path(path)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'GET_DATA',
-                                          'path': path, 'watch': False})
-        pkt = await self._await_op(fut, 'GET_DATA', path, deadline,
-                                   span)
+        pkt = await self._read_request(
+            {'opcode': 'GET_DATA', 'path': path, 'watch': False},
+            'GET_DATA', path, deadline)
         return pkt['data'], pkt['stat']
 
     async def create(self, path: str, data: bytes,
@@ -620,28 +749,32 @@ class Client(FSM):
 
     async def stat(self, path: str, deadline=_USE_DEFAULT) -> Stat:
         self._check_path(path)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'EXISTS',
-                                          'path': path, 'watch': False})
-        pkt = await self._await_op(fut, 'EXISTS', path, deadline, span)
+        pkt = await self._read_request(
+            {'opcode': 'EXISTS', 'path': path, 'watch': False},
+            'EXISTS', path, deadline)
         return pkt['stat']
 
     async def get_acl(self, path: str, deadline=_USE_DEFAULT):
         self._check_path(path)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'GET_ACL',
-                                          'path': path})
-        pkt = await self._await_op(fut, 'GET_ACL', path, deadline, span)
+        pkt = await self._read_request(
+            {'opcode': 'GET_ACL', 'path': path},
+            'GET_ACL', path, deadline)
         return pkt['acl']
 
     async def sync(self, path: str, deadline=_USE_DEFAULT) -> None:
         """Flush the leader pipeline to the connected server
-        (reference: lib/client.js:578-597)."""
+        (reference: lib/client.js:578-597).
+
+        With the read plane on this is a REAL leader barrier for
+        read-your-writes across sessions: the serving member applies
+        everything the leader committed before replying, the reply
+        header stamps that position into the session floor, and every
+        later distributed read is zxid-gated above it — so state
+        another session wrote before this sync can never be missed by
+        a follower- or observer-served read afterwards."""
         self._check_path(path)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'SYNC',
-                                          'path': path})
-        await self._await_op(fut, 'SYNC', path, deadline, span)
+        await self._primary_request(
+            {'opcode': 'SYNC', 'path': path}, 'SYNC', path, deadline)
 
     async def multi(self, ops: list, deadline=_USE_DEFAULT) -> list:
         """One all-or-nothing MULTI transaction (opcode 14): ``ops``

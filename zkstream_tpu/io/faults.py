@@ -1013,6 +1013,15 @@ class FaultPlan:
     #: (check_multi_atomic) then demands whole-or-nothing visibility
     #: in the final tree AND across the crash-image recovery
     multis: int = 0
+    #: non-voting observer members attached to the ensemble (README
+    #: "Read plane"); their lag/partition churn draws come from their
+    #: OWN RNG stream, and the schedule's clients run with the
+    #: client-side read plane on (reads fan out over the whole
+    #: membership, zxid-gated) — the session-monotone read check
+    #: (check_session_reads, wired into check_history) is the
+    #: invariant under test.  Part of the rerun key:
+    #: ``chaos --observers N``.
+    observers: int = 0
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -1037,6 +1046,11 @@ class FaultPlan:
         # same rule again for the MULTI pillar (PR 12)
         mrng = random.Random('plan-multi/%d' % (seed,))
         plan.multis = mrng.choice([0, 1, 1, 2])
+        # and again for the read plane (PR 15): the observer count
+        # rides a fresh stream, so every draw existing seeds pinned
+        # still produces the same value
+        obrng = random.Random('plan-observers/%d' % (seed,))
+        plan.observers = obrng.choice([0, 0, 0, 1, 2])
         return plan
 
     def forced_election_steps(self) -> set[int]:
@@ -1080,7 +1094,7 @@ class EnsembleUnderTest:
     def __init__(self, members: int = 3, wal_dir: str | None = None,
                  durability: str | None = None,
                  wal_segment_bytes: int | None = None,
-                 seed: int | None = None):
+                 seed: int | None = None, observers: int = 0):
         from ..server.replication import ReplicationService
         from ..server.server import ZKEnsemble
 
@@ -1089,9 +1103,13 @@ class EnsembleUnderTest:
         self._ens = ZKEnsemble(members, lag=0.0, wal_dir=wal_dir,
                                durability=durability,
                                wal_segment_bytes=wal_segment_bytes,
-                               heartbeat_ms=40, seed=seed)
+                               heartbeat_ms=40, seed=seed,
+                               observers=observers)
         self.db = self._ens.db
         self.servers = self._ens.servers
+        #: voting membership: members at index >= voters are
+        #: observers (non-voting read-serving replicas)
+        self.voters = self._ens.voters
         self.coordinator = self._ens.election
         self.svc = ReplicationService(self.db)
         self.dead: set[int] = set()
@@ -1168,7 +1186,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 collector=None,
                                 plan: FaultPlan | None = None,
                                 elections: int | None = None,
-                                clients: int | None = None
+                                clients: int | None = None,
+                                observers: int | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
     client workload, every op recorded into an append-only history,
@@ -1176,12 +1195,15 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     the leader's final database.  ``clients`` > 1 switches to the
     concurrent tier (:func:`run_concurrent_schedule`): N clients
     writing overlapping keys, checked per key for linearizability
-    (invariant 9).  Any failure is reproducible with ``python -m
-    zkstream_tpu chaos --tier ensemble --seed N [--clients N]``."""
+    (invariant 9).  ``observers`` overrides the plan's non-voting
+    member count (read plane; their churn rides a fresh RNG
+    stream).  Any failure is reproducible with ``python -m
+    zkstream_tpu chaos --tier ensemble --seed N [--clients N]
+    [--observers N]``."""
     if clients is not None and clients > 1:
         return await run_concurrent_schedule(
             seed, ops=ops, clients=clients, collector=collector,
-            plan=plan, elections=elections)
+            plan=plan, elections=elections, observers=observers)
     from ..client import Client
     from ..protocol.consts import CreateFlag
     from .backoff import BackoffPolicy
@@ -1197,6 +1219,11 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         # explicit override (chaos --elections N): part of the rerun
         # key — seed + flags reproduce the schedule exactly
         plan.elections = elections
+    if observers is not None:
+        plan.observers = observers
+    #: observer churn draws ride their own stream (fresh per seed):
+    #: attaching observers must not shift any draw existing seeds pin
+    orng = random.Random('churn-obs/%d' % (seed,))
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble')
     h = History()
@@ -1205,7 +1232,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     crash_dir = tempfile.mkdtemp(prefix='zkchaos-ens-crash-')
     ens = await EnsembleUnderTest(
         plan.members, wal_dir=wal_dir, durability=plan.durability,
-        wal_segment_bytes=plan.wal_segment_bytes, seed=seed).start()
+        wal_segment_bytes=plan.wal_segment_bytes, seed=seed,
+        observers=plan.observers).start()
     ens.install_faults(inj)
 
     ingest = None
@@ -1221,6 +1249,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         session_timeout=plan.session_timeout, seed=seed, faults=inj,
         op_timeout=CAMPAIGN_OP_DEADLINE_MS, collector=collector,
         ingest=ingest, trace_capacity=512,
+        # with observers attached the client-side read plane is on:
+        # reads fan out across the whole membership, zxid-gated, and
+        # check_session_reads holds the session-monotone rung
+        read_distribution=plan.observers > 0,
         decoherence_interval=(plan.decoherence_ms
                               if plan.decoherence_ms is not None
                               else DEFAULT_DECOHERENCE_INTERVAL),
@@ -1273,8 +1305,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         the real one (heartbeat monitor), not a direct call."""
         if ens.coordinator is None:
             return
-        need = len(ens.servers) // 2 + 1
-        while ens.dead and len(ens.live()) - 1 < need:
+        need = ens.voters // 2 + 1
+        while ens.dead and \
+                len([j for j in ens.live() if j < ens.voters]) - 1 \
+                < need:
             back = sorted(ens.dead)[0]
             note_member('restart', back)
             await ens.restart(back)
@@ -1466,7 +1500,9 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                     note_member('kill', victim)
                     await ens.kill(victim)
             elif act == 'kill_follower':
-                live = [j for j in ens.live() if j != 0]
+                # voters only: observer churn rides its own stream
+                live = [j for j in ens.live()
+                        if j != 0 and j < ens.voters]
                 if not live or len(ens.live()) <= 1:
                     continue
                 victim = inj.choice('plan', live)
@@ -1493,7 +1529,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                     note_member('heal', 'replica')
             elif act == 'lag':
                 idx = inj.choice('plan',
-                                 range(1, len(ens.servers)))
+                                 range(1, ens.voters))
                 lag = inj.choice('plan', (None, 0.05, 0.0))
                 note_member('lag=%r' % (lag,), idx)
                 ens.set_lag(idx, lag)
@@ -1501,6 +1537,27 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 assert act == 'migrate', act
                 note_member('migrate', '-')
                 client.pool.rebalance_now()
+            if plan.observers:
+                # observer fault vocabulary, on its OWN stream: lag
+                # windows, a sustained park (the partition shape — a
+                # partitioned observer's replica stops applying, so
+                # only ITS sessions' reads gate-block or bounce) and
+                # heals.  The zxid read gate is the invariant under
+                # test: check_session_reads must stay clean.
+                oact = orng.choice(('none', 'none', 'lag', 'park',
+                                    'heal'))
+                if oact != 'none':
+                    oidx = ens.voters + orng.randrange(plan.observers)
+                    if oact == 'lag':
+                        olag = orng.choice((0.05, 0.0))
+                        note_member('observer-lag=%r' % (olag,), oidx)
+                        ens.set_lag(oidx, olag)
+                    elif oact == 'park':
+                        note_member('observer-partition', oidx)
+                        ens.set_lag(oidx, None)
+                    else:
+                        note_member('observer-heal', oidx)
+                        ens.set_lag(oidx, 0.0)
 
         # -- verification: faults off, ensemble healed --------------
         inj.stop()
@@ -1638,16 +1695,19 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
 async def run_ensemble_campaign(base_seed: int, schedules: int,
                                 ops: int = 12, progress=None,
                                 elections: int | None = None,
-                                clients: int | None = None
+                                clients: int | None = None,
+                                observers: int | None = None
                                 ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
     starting at ``base_seed`` (``clients`` > 1: the concurrent
-    tier, every schedule linearizability-checked)."""
+    tier, every schedule linearizability-checked; ``observers``
+    overrides every plan's non-voting member count)."""
     out = []
     for i in range(schedules):
         r = await run_ensemble_schedule(base_seed + i, ops=ops,
                                         elections=elections,
-                                        clients=clients)
+                                        clients=clients,
+                                        observers=observers)
         out.append(r)
         if progress is not None:
             progress(r)
@@ -1686,7 +1746,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                                   clients: int = 3,
                                   collector=None,
                                   plan: FaultPlan | None = None,
-                                  elections: int | None = None
+                                  elections: int | None = None,
+                                  observers: int | None = None
                                   ) -> ScheduleResult:
     """One seeded concurrent schedule: ``clients`` Clients driven
     from per-client RNG streams drawn fresh from the FaultPlan, each
@@ -1719,6 +1780,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         plan = FaultPlan.randomized(seed, ops=ops)
     if elections is not None:
         plan.elections = elections
+    if observers is not None:
+        plan.observers = observers
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble',
                          clients=clients)
@@ -1726,12 +1789,16 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     rngs = [random.Random('client/%d/%d' % (seed, ci))
             for ci in range(clients)]
     crng = random.Random('churn/%d' % (seed,))
+    #: observer churn rides its own stream — attaching observers
+    #: must not shift the per-client or churn draws existing seeds pin
+    orng = random.Random('churn-obs/%d' % (seed,))
 
     wal_dir = tempfile.mkdtemp(prefix='zkchaos-conc-wal-')
     crash_dir = tempfile.mkdtemp(prefix='zkchaos-conc-crash-')
     ens = await EnsembleUnderTest(
         plan.members, wal_dir=wal_dir, durability=plan.durability,
-        wal_segment_bytes=plan.wal_segment_bytes, seed=seed).start()
+        wal_segment_bytes=plan.wal_segment_bytes, seed=seed,
+        observers=plan.observers).start()
     ens.install_faults(inj)
 
     ingest = None
@@ -1753,6 +1820,10 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
             seed=seed * 131 + ci, faults=inj,
             op_timeout=CAMPAIGN_OP_DEADLINE_MS, collector=collector,
             ingest=ingest, trace_capacity=512,
+            # the read plane rides along whenever observers are
+            # attached: distributed reads are zxid-gated and the
+            # history must still pass check_session_reads
+            read_distribution=plan.observers > 0,
             decoherence_interval=(plan.decoherence_ms
                                   if plan.decoherence_ms is not None
                                   else DEFAULT_DECOHERENCE_INTERVAL),
@@ -1798,8 +1869,10 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     async def force_election() -> None:
         if ens.coordinator is None:
             return
-        need = len(ens.servers) // 2 + 1
-        while ens.dead and len(ens.live()) - 1 < need:
+        need = ens.voters // 2 + 1
+        while ens.dead and \
+                len([j for j in ens.live() if j < ens.voters]) - 1 \
+                < need:
             back = sorted(ens.dead)[0]
             note_member('restart', back)
             await ens.restart(back)
@@ -1948,7 +2021,7 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                 await force_election()
             act = crng.choice(CONCURRENT_CHURN)
             if act == 'kill_any':
-                live = ens.live()
+                live = [j for j in ens.live() if j < ens.voters]
                 if len(live) > 1:
                     victim = crng.choice(live)
                     note_member('kill', victim)
@@ -1969,7 +2042,7 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                 else:
                     note_member('heal', 'replica')
             elif act == 'lag':
-                idx = crng.choice(range(1, len(ens.servers)))
+                idx = crng.choice(range(1, ens.voters))
                 lag = crng.choice((None, 0.05, 0.0))
                 note_member('lag=%r' % (lag,), idx)
                 ens.set_lag(idx, lag)
@@ -1977,6 +2050,25 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                 note_member('migrate', '-')
                 for c in cls:
                     c.pool.rebalance_now()
+            if plan.observers:
+                # observer lag/partition vocabulary on its own
+                # stream (same shape as the single-client tier)
+                oact = orng.choice(('none', 'none', 'lag', 'park',
+                                    'heal'))
+                if oact != 'none':
+                    oidx = ens.voters \
+                        + orng.randrange(plan.observers)
+                    if oact == 'lag':
+                        olag = orng.choice((0.05, 0.0))
+                        note_member('observer-lag=%r' % (olag,),
+                                    oidx)
+                        ens.set_lag(oidx, olag)
+                    elif oact == 'park':
+                        note_member('observer-partition', oidx)
+                        ens.set_lag(oidx, None)
+                    else:
+                        note_member('observer-heal', oidx)
+                        ens.set_lag(oidx, 0.0)
             await asyncio.sleep(crng.uniform(0.005, 0.04))
 
     try:

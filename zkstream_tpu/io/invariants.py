@@ -598,7 +598,10 @@ def check_election(history: History) -> list[str]:
 def check_history(history: History, db) -> list[str]:
     """Run every invariant against the history and the leader's
     final database; returns the combined violation list."""
-    from ..analysis.linearize import check_linearizable
+    from ..analysis.linearize import (
+        check_linearizable,
+        check_session_reads,
+    )
 
     out: list[str] = []
     out.extend(check_acked_durability(history, db))
@@ -611,6 +614,13 @@ def check_history(history: History, db) -> list[str]:
     # invariant 9: per-key WGL linearizability over the interval
     # records (vacuous on histories that carry none)
     out.extend(check_linearizable(history, db))
+    # the session-monotone read rung (the read plane's acceptance,
+    # PR 15): a session never observes state older than it has
+    # already seen — held by the zxid read gate (server/server.py
+    # ReadGate + the client plane's header-zxid validation); the
+    # env-gated ungated validator (ZKSTREAM_NO_READ_GATE=1) is what
+    # this checker exists to catch
+    out.extend(check_session_reads(history))
     return out
 
 

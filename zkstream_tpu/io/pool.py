@@ -24,6 +24,7 @@ module implements the same observable behavior directly:
 from __future__ import annotations
 
 import asyncio
+import os
 import random
 
 from ..utils.events import EventEmitter
@@ -46,6 +47,110 @@ DEFAULT_POLICY = BackoffPolicy(timeout=5000, retries=3,
 #: How often to try moving back to a more-preferred backend, ms
 #: (reference: decoherenceInterval 600 s, lib/client.js:110-111).
 DEFAULT_DECOHERENCE_INTERVAL = 600 * 1000
+
+
+def read_distribution_default() -> bool:
+    """Process-wide default for new clients: ``ZKSTREAM_READ_
+    DISTRIBUTION=1`` turns the client-side read plane on (off by
+    default — single-connection clients keep the legacy shape)."""
+    return os.environ.get('ZKSTREAM_READ_DISTRIBUTION') == '1'
+
+
+class ReadPlane:
+    """Client-side read scale-out (README "Read plane"): one
+    lightweight read client per backend, so ``get``/``exists``/
+    ``getACL``/``list`` fan out across followers and observers while
+    writes, watches, MULTI and ``sync`` stay on the primary session.
+
+    The ZooKeeper session contract survives the fan-out because every
+    distributed read is zxid-gated TWICE:
+
+    - client-side, the reply header carries the serving member's
+      applied zxid; a reply below the client's floor (the newest zxid
+      any of its connections has shown it — writes, reads, watch
+      fires and the ``sync`` barrier all advance it) is DISCARDED and
+      the read re-issued on the primary connection, whose member view
+      is session-consistent by construction.  Stale state is never
+      surfaced (``bounced`` counts these);
+    - server-side, each read session carries its own
+      ``lastZxidSeen``-seeded floor and the member's ReadGate blocks
+      or bounces behind it (server/server.py).
+
+    Spec verdicts (NO_NODE...) from a read session CANNOT be
+    zxid-validated — an error reply carries no observable state — so
+    they bounce to the primary too; only the primary's verdict is
+    ever surfaced.  Every read therefore costs at most two RTTs and
+    usually one, on a member that is not the write path."""
+
+    def __init__(self, client, backends: list[Backend]):
+        self._client = client
+        self._backends = list(backends)
+        self.subs: list = []          # one lightweight Client each
+        self._rr = 0
+        self.started = False
+        #: reads served by the plane / discarded-stale re-issues /
+        #: sub-connection failures that fell back to the primary
+        self.distributed = 0
+        self.bounced = 0
+        self.fallbacks = 0
+
+    def start(self) -> None:
+        """Dial one read client per backend (lazy sub-sessions: each
+        is a full handshake — the read capacity IS those sessions
+        landing on followers/observers)."""
+        if self.started:
+            return
+        self.started = True
+        from ..client import Client   # deferred: client.py imports us
+        c = self._client
+        for i, b in enumerate(self._backends):
+            # inherit the parent's seed (derived per backend) and
+            # retry policies: chaos rerun-key determinism reaches the
+            # read sessions' backoff jitter too
+            seed = (None if c._seed is None
+                    else c._seed * 1000003 + i + 1)
+            sub = Client(address=b.address, port=b.port,
+                         session_timeout=c.session_timeout,
+                         shuffle_backends=False, max_spares=0,
+                         op_timeout=c.op_timeout, faults=c.faults,
+                         log=c.log, seed=seed,
+                         connect_policy=c.pool._connect_policy,
+                         default_policy=c._retry_policy,
+                         read_distribution=False)
+            sub.start()
+            self.subs.append(sub)
+
+    def pick(self, avoid_key: str | None = None):
+        """The next connected read client, round-robin, preferring
+        backends other than ``avoid_key`` (the primary's — reading
+        there would not offload it); None when none is usable."""
+        if not self.subs:
+            return None
+        n = len(self.subs)
+        fallback = None
+        for i in range(n):
+            sub = self.subs[(self._rr + i) % n]
+            if not sub.is_connected():
+                continue
+            key = sub.pool.backends[0].key
+            if avoid_key is not None and key == avoid_key:
+                fallback = fallback or (i, sub)
+                continue
+            self._rr = (self._rr + i + 1) % n
+            return sub
+        if fallback is not None:
+            i, sub = fallback
+            self._rr = (self._rr + i + 1) % n
+            return sub
+        return None
+
+    async def close(self) -> None:
+        subs, self.subs = self.subs, []
+        for sub in subs:
+            try:
+                await asyncio.wait_for(sub.close(), 5)
+            except (asyncio.TimeoutError, TimeoutError):
+                sub.pool.stop()
 
 
 class ConnectionPool(EventEmitter):

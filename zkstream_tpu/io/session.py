@@ -74,6 +74,18 @@ class ZKSession(FSM):
         self.session_id = 0
         self.passwd = b'\x00' * 16
 
+        #: Zxid floor observed OUTSIDE this session's own connection —
+        #: the client's read plane (io/pool.py ReadPlane) bumps it
+        #: with every distributed read it accepts, and the previous
+        #: session's floor carries into it on replacement.  Presented
+        #: at every handshake (max with ``last_zxid``) so the
+        #: server-side zxid read gate covers what the CLIENT has seen,
+        #: not just this connection; kept separate from ``last_zxid``
+        #: because that one is also the SET_WATCHES relZxid — raising
+        #: it for state observed via OTHER sessions could suppress
+        #: catch-up notifications this connection still owes.
+        self.gate_floor = 0
+
         #: Optional override for crash-on-bug escalation (see
         #: :meth:`fatal_error`); None = loud default (loop exception
         #: handler after teardown).
@@ -252,7 +264,7 @@ class ZKSession(FSM):
 
         self.conn.send({
             'protocolVersion': consts.PROTOCOL_VERSION,
-            'lastZxidSeen': self.last_zxid,
+            'lastZxidSeen': max(self.last_zxid, self.gate_floor),
             'timeOut': self.timeout,
             'sessionId': self.session_id,
             'passwd': self.passwd,
@@ -351,7 +363,7 @@ class ZKSession(FSM):
 
         self.conn.send({
             'protocolVersion': consts.PROTOCOL_VERSION,
-            'lastZxidSeen': self.last_zxid,
+            'lastZxidSeen': max(self.last_zxid, self.gate_floor),
             'timeOut': self.timeout,
             'sessionId': self.session_id,
             'passwd': self.passwd,

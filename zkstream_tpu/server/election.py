@@ -187,10 +187,16 @@ class ElectionCoordinator(EventEmitter):
     """
 
     def __init__(self, servers, db, heartbeat_ms: int | None = None,
-                 seed: int | None = None, collector=None):
+                 seed: int | None = None, collector=None,
+                 voters: int | None = None):
         super().__init__()
         self.servers = servers
         self.db = db
+        #: The VOTING membership: members ``0..voters-1``.  Members
+        #: past it are observers (README "Read plane") — they never
+        #: enter a ballot, never win, and never count toward the
+        #: election quorum denominator.
+        self.voters = voters if voters is not None else len(servers)
         self.heartbeat_ms = (heartbeat_ms if heartbeat_ms is not None
                              else DEFAULT_HEARTBEAT_MS)
         self.leader_idx = 0
@@ -211,7 +217,9 @@ class ElectionCoordinator(EventEmitter):
         if collector is not None:
             self.bind_metrics(collector)
         for i, s in enumerate(self.servers):
-            s.role = 'leader' if i == self.leader_idx else 'follower'
+            if i < self.voters:
+                s.role = ('leader' if i == self.leader_idx
+                          else 'follower')
             s.elections_ref = self
             s.fence = (lambda idx=i: idx in self.deposed)
 
@@ -265,7 +273,9 @@ class ElectionCoordinator(EventEmitter):
     # -- the election itself --
 
     def _candidates(self) -> list[int]:
-        return [i for i in range(len(self.servers))
+        # voters only: an observer holds the same history but must
+        # never stand (or be counted reachable) in an election
+        return [i for i in range(self.voters)
                 if self._alive(i) and i not in self.partitioned]
 
     async def elect(self, reason: str) -> int | None:
@@ -279,7 +289,7 @@ class ElectionCoordinator(EventEmitter):
         t0 = time.perf_counter()
         try:
             cands = self._candidates()
-            if len(cands) < quorum_of(len(self.servers)):
+            if len(cands) < quorum_of(self.voters):
                 return None
             self.emit('electing', reason)
             for i in cands:
@@ -289,7 +299,7 @@ class ElectionCoordinator(EventEmitter):
             # kill racing the vote lands before the tally
             await asyncio.sleep(0)
             cands = self._candidates()
-            if len(cands) < quorum_of(len(self.servers)):
+            if len(cands) < quorum_of(self.voters):
                 for i in self._candidates():
                     self.servers[i].role = 'follower'
                 return None
@@ -374,9 +384,18 @@ class ElectionPeer:
                  host: str = '127.0.0.1', port: int = 0,
                  policy: BackoffPolicy = PEER_POLICY,
                  seed: int | None = None,
-                 promise_dir: str | None = None):
+                 promise_dir: str | None = None,
+                 observer: bool = False):
         self.member_id = member_id
         self.peers = list(peers)          # [(id, host, election_port)]
+        #: ``total`` is the VOTING membership.  An observer peer
+        #: (README "Read plane") is outside it: its vote replies are
+        #: stamped ``observer`` (excluded from every ballot and every
+        #: reachable-quorum count), it denies every claim (a grant
+        #: from outside the voter set must never help a candidate
+        #: assemble a "quorum"), and :meth:`resolve` never stands —
+        #: it only ever follows a leader the voters elected.
+        self.observer = observer
         self.total = total
         self.host = host
         self.port = port
@@ -438,6 +457,8 @@ class ElectionPeer:
         however long the claimant takes to promote), and never to a
         target at or below the epoch already standing here.  The same
         candidate re-claiming is idempotent."""
+        if self.observer:
+            return False              # observers never arbitrate
         epoch = self.epoch_fn()
         for t in [t for t in self._grants if t <= epoch]:
             del self._grants[t]       # settled eras: prune
@@ -464,8 +485,11 @@ class ElectionPeer:
         try:
             msg = await asyncio.wait_for(_read_msg(reader), 5.0)
             if msg[0] == 'vote?':
+                # an observer's reply is stamped as such: voters drop
+                # it from ballots and reachable-quorum counts
+                state = 'observer' if self.observer else self.state
                 writer.write(_dump(
-                    ('vote', self.member_id, self.state,
+                    ('vote', self.member_id, state,
                      self.epoch_fn(), self.zxid_fn(),
                      self.repl_port)))
                 await writer.drain()
@@ -550,8 +574,18 @@ class ElectionPeer:
                                 if i == best[1])
                     return ('follow', (best[1], host, best[5],
                                        best[3]))
-            if len(replies) + 1 >= quorum_of(self.total):
-                votes = [Vote(r[3], r[4], r[1]) for r in replies]
+            if self.observer:
+                # never stand: keep polling until a voter-elected
+                # leader answers (jittered, like a denied candidate)
+                await asyncio.sleep(backoff.next_delay() / 1000.0)
+                continue
+            # observers are outside the ballot AND the reachable
+            # count: total is the voting membership
+            voter_replies = [r for r in replies
+                             if r[2] != 'observer']
+            if len(voter_replies) + 1 >= quorum_of(self.total):
+                votes = [Vote(r[3], r[4], r[1])
+                         for r in voter_replies]
                 my_vote = Vote(my_epoch, my_zxid, self.member_id)
                 votes.append(my_vote)
                 win = tally(votes)
@@ -583,12 +617,22 @@ class ElectionPeer:
 async def run_member(member_id: int, wal_dir: str, client_port: int,
                      election_port: int, peers,
                      sync: str = 'tick',
-                     ready_cb=None) -> None:
+                     ready_cb=None, observer: bool = False,
+                     voters: int | None = None) -> None:
     """One symmetric ensemble-member process: recover local state,
     run elections forever, serve clients on ``client_port`` whatever
     the current role.  ``peers`` is ``[(id, host, election_port)]``
     for every OTHER member.  Runs until the process is killed —
-    being SIGKILLed mid-role is the point of the tier."""
+    being SIGKILLed mid-role is the point of the tier.
+
+    ``observer=True`` makes this member a non-voting read-serving
+    replica (README "Read plane"): it receives the replication
+    stream, serves reads/watches/sessions and forwards writes like
+    any follower, but never stands in an election, never grants a
+    claim, and its replication acks never count toward the
+    quorum-commit majority.  ``voters`` is the VOTING membership size
+    (observer peers excluded); default = every peer plus self, the
+    observer-free legacy shape."""
     from .persist import (
         WriteAheadLog,
         attach_wal,
@@ -614,9 +658,10 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
         'epoch': rec.epoch,
         'zxid_fn': (lambda: rec.zxid),
     }
-    peer = ElectionPeer(member_id, peers, total=len(peers) + 1,
+    voting_total = voters if voters is not None else len(peers) + 1
+    peer = ElectionPeer(member_id, peers, total=voting_total,
                         port=election_port, seed=member_id,
-                        promise_dir=wal_dir)
+                        promise_dir=wal_dir, observer=observer)
     peer.epoch_fn = lambda: state['epoch']
     peer.zxid_fn = lambda: state['zxid_fn']()
     await peer.start()
@@ -682,12 +727,12 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             new_epoch = max(target_epoch, db.epoch + 1)
             db.bump_epoch(new_epoch)
             reap_orphan_ephemerals(db)
-            # quorum-commit: the whole membership is the voter set,
-            # so a write acked through THIS leader is majority-held
-            # before the ack leaves (follower acks piggyback
-            # applied_zxid on the replication channels)
+            # quorum-commit: the VOTING membership is the voter set
+            # (observer mirrors ack for the truncation floor but
+            # never toward the majority), so a write acked through
+            # THIS leader is majority-held before the ack leaves
             svc = await ReplicationService(
-                db, total=len(peers) + 1).start()
+                db, total=voting_total).start()
             state['epoch'] = new_epoch
             state['zxid_fn'] = lambda db=db: db.zxid
             store = None
@@ -759,7 +804,8 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                 remote.close()
             remote = RemoteLeader(host, repl_port,
                                   have_zxid=have_zxid,
-                                  epoch=cur_epoch)
+                                  epoch=cur_epoch,
+                                  observer=observer)
             # the durable session table this member already holds (a
             # mirror it served, a led era, or its recovered WAL)
             # seeds the new mirror handle — resync ships only the
@@ -812,15 +858,18 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             state['zxid_fn'] = lambda s=store: s.zxid
             led_db = None                 # rejoined the current era
             peer.note_following()
+            member_role = 'observer' if observer else 'follower'
             if server is None:
-                announce(await ZKServer(
+                srv = await ZKServer(
                     remote, store=store, port=client_port,
-                    member='m%d' % (member_id,)).start())
+                    member='m%d' % (member_id,)).start()
+                srv.role = member_role
+                announce(srv)
             else:
                 # a follower's acks gate on its mirror WAL alone: the
                 # quorum half belongs to the leader's RPC response
                 server.quorum = None
-                server.repoint(remote, store=store, role='follower')
+                server.repoint(remote, store=store, role=member_role)
             # a follower at the current epoch is not fenced: stale-
             # epoch protection for its forwarded writes lives in the
             # RPC stamp (the service bounces them)
@@ -848,14 +897,18 @@ PROC_LEADER_S = 45.0
 
 
 class ProcMember:
-    """One spawned member process and its fixed ports."""
+    """One spawned member process and its fixed ports.
+    ``observer=True`` spawns a non-voting read-serving member
+    (``member_worker.py --observer``)."""
 
     def __init__(self, member_id: int, wal_dir: str,
-                 client_port: int, election_port: int):
+                 client_port: int, election_port: int,
+                 observer: bool = False):
         self.member_id = member_id
         self.wal_dir = wal_dir
         self.client_port = client_port
         self.election_port = election_port
+        self.observer = observer
         self.proc = None
 
     def alive(self) -> bool:
@@ -867,7 +920,11 @@ class ProcMember:
         args = [sys.executable, MEMBER_WORKER, str(self.member_id),
                 self.wal_dir, str(self.client_port),
                 str(self.election_port)]
-        args += ['%d:127.0.0.1:%d' % (m.member_id, m.election_port)
+        if self.observer:
+            args.append('--observer')
+        args += ['%d:127.0.0.1:%d%s'
+                 % (m.member_id, m.election_port,
+                    ':observer' if m.observer else '')
                  for m in peers if m.member_id != self.member_id]
         self.proc = subprocess.Popen(
             args, stdout=subprocess.PIPE,
@@ -938,7 +995,8 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                members: int = 3, elections: int = 2,
                                generations: int = 2,
                                workdir: str | None = None,
-                               clients: int | None = None):
+                               clients: int | None = None,
+                               observers: int = 0):
     """One seeded OS-process election schedule: spawn ``members``
     symmetric peer processes over per-member WAL dirs, drive a seeded
     workload THROUGH THE LEADER (quorum-commit makes its ack
@@ -972,15 +1030,20 @@ async def run_process_schedule(seed: int, ops: int = 6,
     from ..protocol.errors import ZKError, ZKProtocolError
 
     rng = random.Random('proc/%d' % (seed,))
+    #: observer churn draws come from their OWN stream: attaching
+    #: observers must not perturb the schedule existing seeds pin
+    orng = random.Random('proc-obs/%d' % (seed,))
     res = ScheduleResult(seed=seed, tier='process',
                          clients=clients if clients else 1)
     h = History()
     root = workdir or tempfile.mkdtemp(prefix='zkproc-elect-')
     own_root = workdir is None
-    ports = allocate_ports(2 * members)
+    total = members + observers
+    ports = allocate_ports(2 * total)
     fleet = [ProcMember(i, os.path.join(root, 'm%d' % i),
-                        ports[2 * i], ports[2 * i + 1])
-             for i in range(members)]
+                        ports[2 * i], ports[2 * i + 1],
+                        observer=i >= members)
+             for i in range(total)]
     expected: dict[str, bytes] = {}
     deleted: set[str] = set()
 
@@ -994,13 +1057,16 @@ async def run_process_schedule(seed: int, ops: int = 6,
         majority of mirrors has ingested the txn — so the schedule
         writes through the leader and asserts exactly that (the
         follower-routing workaround this schedule used to need is
-        gone)."""
+        gone).  With observers attached the client runs with the
+        read plane on (the ensemble tier's rule: `--observers` puts
+        the distributed, zxid-gated read path under test here too)."""
         backends = [('127.0.0.1', m.client_port) for m in fleet
                     if m.alive() and m.member_id == leader_id]
         backends += [('127.0.0.1', m.client_port) for m in fleet
                      if m.alive() and m.member_id != leader_id]
         c = Client(servers=backends, shuffle_backends=False,
                    session_timeout=12000, op_timeout=3000,
+                   seed=seed, read_distribution=observers > 0,
                    connect_policy=BackoffPolicy(timeout=2000,
                                                 retries=4, delay=100,
                                                 cap=1000))
@@ -1075,6 +1141,12 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                  % (seed, phase, ci))
             spans = [None]
             c.on_op = lambda span: spans.__setitem__(0, span)
+            # each phase's client is a FRESH session: the history's
+            # client id is phase-qualified so the session-monotone
+            # read check (check_session_reads) floors each session
+            # separately instead of chaining floors across sessions
+            # that share no lastZxidSeen carry
+            hci = phase * clients + ci
             try:
                 for i in range(ops):
                     res.ops += 1
@@ -1082,7 +1154,7 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                         'get', 'get'))
                     key = crng.choice(lin_keys)
                     tag = b'p%d-c%d-%d' % (phase, ci, i)
-                    call = h.invoke(kind, key, client=ci,
+                    call = h.invoke(kind, key, client=hci,
                                     data=tag if kind != 'get'
                                     else None)
                     try:
@@ -1152,6 +1224,17 @@ async def run_process_schedule(seed: int, ops: int = 6,
         # -- elected-leader kill loop: >= `elections` forced ---------
         for round_no in range(elections):
             await work(round_no, leader_id)
+            if observers and orng.random() < 0.5:
+                # observer churn (own RNG stream): SIGKILL one and
+                # respawn it — it must recover its mirror WAL and
+                # re-follow without ever standing in the election
+                ob = fleet[members + orng.randrange(observers)]
+                if ob.alive():
+                    h.member_event('kill-observer', ob.member_id)
+                    ob.kill()
+                    ob.spawn(fleet)
+                    await ob.wait_ready()
+                    h.member_event('restart', ob.member_id)
             victim = next(m for m in fleet
                           if m.member_id == leader_id)
             # leader-killed-after-ack: one marker write THROUGH THE
@@ -1245,7 +1328,41 @@ async def run_process_schedule(seed: int, ops: int = 6,
             finally:
                 await c.close()
             res.violations.extend(check_linearizable(h, finals))
+            # the session-monotone read gate's acceptance on THIS
+            # tier too (analysis/linearize.py): a session must never
+            # observe state older than it has already seen
+            from ..analysis.linearize import check_session_reads
+            res.violations.extend(check_session_reads(h))
         res.violations.extend(check_election(h))
+        if observers:
+            # observers must never have stood: every recorded
+            # election winner is a voter, and every live observer
+            # still reports the observer role
+            for r in h.of_kind('election'):
+                if isinstance(r['member'], int) \
+                        and r['member'] >= members:
+                    res.violations.append(
+                        'observer %s won an election at epoch %d '
+                        '(observers must never stand)'
+                        % (r['member'], r['epoch']))
+            for ob in fleet[members:]:
+                if not ob.alive():
+                    continue
+                try:
+                    rows = await _scrape_mntr(ob.client_port)
+                except (OSError, asyncio.TimeoutError, TimeoutError):
+                    continue
+                if rows.get('zk_member_role') != 'observer':
+                    res.violations.append(
+                        'member %d spawned as observer reports role '
+                        '%r' % (ob.member_id,
+                                rows.get('zk_member_role')))
+            # read scale-out correctness: the acked tree must read
+            # back through an OBSERVER too (sync barrier first — the
+            # forwarded RPC piggyback is the catch-up)
+            await verify(fleet[members].member_id,
+                         'read-back through observer %d'
+                         % (fleet[members].member_id,))
         return res
     except (TimeoutError, asyncio.TimeoutError) as e:
         res.violations.append('process schedule stalled: %s' % (e,))
@@ -1266,18 +1383,21 @@ async def run_process_schedule(seed: int, ops: int = 6,
 async def run_process_campaign(base_seed: int, schedules: int,
                                ops: int = 6, progress=None,
                                elections: int | None = None,
-                               clients: int | None = None):
+                               clients: int | None = None,
+                               observers: int | None = None):
     """Consecutive seeded process-tier schedules from ``base_seed``.
-    ``elections`` overrides the per-schedule forced leader-kill count
-    and ``clients`` > 1 makes every workload phase concurrent with
-    the linearizability pass at the end (both part of the rerun key,
-    like the ensemble tier's flags)."""
+    ``elections`` overrides the per-schedule forced leader-kill count,
+    ``clients`` > 1 makes every workload phase concurrent with
+    the linearizability pass at the end, and ``observers`` attaches N
+    non-voting read-serving members with their own churn stream (all
+    part of the rerun key, like the ensemble tier's flags)."""
     out = []
     for i in range(schedules):
         r = await run_process_schedule(
             base_seed + i, ops=ops,
             elections=elections if elections is not None else 2,
-            clients=clients)
+            clients=clients,
+            observers=observers if observers is not None else 0)
         out.append(r)
         if progress is not None:
             progress(r)

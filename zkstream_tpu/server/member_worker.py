@@ -10,11 +10,15 @@ until killed.  Spawned by the process-tier election harness
 Usage::
 
     python member_worker.py ID WAL_DIR CLIENT_PORT ELECTION_PORT \
-        [PEER_ID:HOST:PORT ...]
+        [--observer] [PEER_ID:HOST:PORT[:observer] ...]
 
 Prints ``READY <client_port> <election_port>`` once the member serves
-clients under its first resolved role.  ``ZKSTREAM_MEMBER_SYNC``
-picks the WAL fsync policy (default ``tick``).
+clients under its first resolved role.  ``--observer`` makes this
+member a non-voting read-serving replica (README "Read plane"); a
+peer spec suffixed ``:observer`` marks that PEER as one, so the
+voting total this member elects against excludes it.
+``ZKSTREAM_MEMBER_SYNC`` picks the WAL fsync policy (default
+``tick``).
 """
 
 from __future__ import annotations
@@ -34,17 +38,37 @@ def main() -> int:
         sys.path.insert(0, root)
     from zkstream_tpu.server.election import run_member
 
+    # a read-plane member may serve thousands of sessions (`make
+    # bench-read`): lift the soft fd limit toward the hard one
+    import resource
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        except (ValueError, OSError):
+            pass
+
     member_id = int(sys.argv[1])
     wal_dir = sys.argv[2]
     client_port = int(sys.argv[3])
     election_port = int(sys.argv[4])
+    rest = sys.argv[5:]
+    observer = '--observer' in rest
     peers = []
-    for spec in sys.argv[5:]:
-        pid, host, port = spec.split(':')
+    peer_voters = 0
+    for spec in rest:
+        if spec == '--observer':
+            continue
+        parts = spec.split(':')
+        pid, host, port = parts[0], parts[1], parts[2]
+        if len(parts) < 4 or parts[3] != 'observer':
+            peer_voters += 1
         peers.append((int(pid), host, int(port)))
+    voters = peer_voters + (0 if observer else 1)
     sync = os.environ.get('ZKSTREAM_MEMBER_SYNC', 'tick')
     asyncio.run(run_member(member_id, wal_dir, client_port,
-                           election_port, peers, sync=sync))
+                           election_port, peers, sync=sync,
+                           observer=observer, voters=voters))
     return 0
 
 

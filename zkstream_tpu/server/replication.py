@@ -438,6 +438,11 @@ class _FollowerHandle:
         self.token = token
         self.applied = 0
         self.shipped = 0
+        #: True for a non-voting observer mirror (README "Read
+        #: plane"): its acks still gate the truncation floor (the
+        #: piggyback must always be able to serve from its mirror's
+        #: end) but never count toward the quorum-commit majority.
+        self.observer = False
         self.writer: asyncio.StreamWriter | None = None
 
 
@@ -529,7 +534,8 @@ class ReplicationService:
         assert have >= db.log_base, (have, db.log_base)
         return have, db.log[have - db.log_base:]
 
-    def _push(self, handle: _FollowerHandle, msg) -> None:
+    def _push(self, handle: _FollowerHandle, msg,
+              data: bytes | None = None) -> None:
         if handle.writer is None:
             return
         # Only steady-state pushes partition: the attach/snapshot
@@ -550,17 +556,29 @@ class ReplicationService:
             # gate the truncation floor, so no entry is lost).
             return
         try:
-            handle.writer.write(_dump(msg))
+            handle.writer.write(data if data is not None
+                                else _dump(msg))
         except (ConnectionError, RuntimeError):
             pass
 
     def _push_commits(self) -> None:
         trace = getattr(self.db, 'trace', None)
         self.quorum.note_pushed(self.db.zxid)
+        #: per-cursor encode memo: steady-state mirrors share one
+        #: shipped position, so a commit's push bytes are pickled
+        #: ONCE however many followers/observers subscribe — the read
+        #: plane makes wide mirror fleets normal, and a per-handle
+        #: pickle would bill every write O(mirrors) serializations
+        memo: dict[int, bytes] = {}
         for h in self._handles.values():
             base, entries = self._entries_from(h.shipped)
             if entries:
-                self._push(h, ('commit', base, entries, self.epoch))
+                data = memo.get(base)
+                if data is None:
+                    data = memo[base] = _dump(
+                        ('commit', base, entries, self.epoch))
+                self._push(h, ('commit', base, entries, self.epoch),
+                           data=data)
                 h.shipped = base + len(entries)
                 if trace is not None:
                     # one push span per follower, keyed by the newest
@@ -597,10 +615,15 @@ class ReplicationService:
         # (server/persist.py) announces the zxid it holds; None for
         # fresh joiners and pre-durability hellos
         have_zxid = hello[2] if len(hello) > 2 else None
+        # a non-voting observer stamps its hello (both channels):
+        # its acks and forwarded writes must never help assemble a
+        # quorum-commit majority
+        is_observer = len(hello) > 3 and hello[3] == 'observer'
         if kind == 'events':
             h = self._handles.get(token)
             if h is None:
                 h = _FollowerHandle(token)
+                h.observer = is_observer
                 h.writer = writer
                 try:
                     self.db.attach_replica(h)
@@ -659,7 +682,10 @@ class ReplicationService:
                     msg = await _read_msg(reader)
                     if msg[0] == 'ack':
                         h.applied = max(h.applied, msg[1])
-                        if len(msg) > 2:
+                        if len(msg) > 2 and not h.observer:
+                            # observer acks advance the truncation
+                            # floor (h.applied above) but never the
+                            # quorum-commit majority
                             self.quorum.note_ack(
                                 h.token, msg[2],
                                 msg[3] if len(msg) > 3 else None)
@@ -668,7 +694,8 @@ class ReplicationService:
             finally:
                 self._detach(h)
         elif kind == 'control':
-            await self._serve_control(reader, writer, token)
+            await self._serve_control(reader, writer, token,
+                                      is_observer=is_observer)
         else:  # pragma: no cover - only this module speaks the protocol
             writer.close()
 
@@ -684,7 +711,8 @@ class ReplicationService:
 
     async def _serve_control(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter,
-                             token: str | None = None) -> None:
+                             token: str | None = None,
+                             is_observer: bool = False) -> None:
         db = self.db
         try:
             while True:
@@ -734,9 +762,14 @@ class ReplicationService:
                         # into its mirror before the client can see
                         # the ack (its loop is parked in the blocking
                         # RPC, so awaiting its real ack would
-                        # deadlock).  Bounded: degrades like the
-                        # send-plane gate.
-                        await self.quorum.wait(db.zxid, grant=token)
+                        # deadlock).  An OBSERVER caller gets no
+                        # virtual grant: its mirror is outside the
+                        # voter set, so the majority must assemble
+                        # from real voter acks alone.  Bounded:
+                        # degrades like the send-plane gate.
+                        await self.quorum.wait(
+                            db.zxid,
+                            grant=None if is_observer else token)
                 base, entries = self._entries_from(have)
                 writer.write(_dump(
                     ('res', seq, status, payload, base, entries,
@@ -793,10 +826,18 @@ class RemoteLeader(EventEmitter):
     the two ``ZKDatabase`` events the server stack subscribes to."""
 
     def __init__(self, host: str, port: int,
-                 have_zxid: int | None = None, epoch: int = 0):
+                 have_zxid: int | None = None, epoch: int = 0,
+                 observer: bool = False):
         super().__init__()
         self.host = host
         self.port = port
+        #: Non-voting observer mirror (README "Read plane"): both
+        #: hellos are stamped so the leader excludes this mirror's
+        #: acks and forwarded writes from quorum-commit majorities.
+        self.observer = observer
+        #: newest mirror index actually ACKED to the leader: observer
+        #: acks batch (see OBS_ACK_BATCH in :meth:`_ingest`)
+        self._acked_sent = 0
         import uuid
         self._token = uuid.uuid4().hex
         #: the zxid this follower recovered from its own WAL
@@ -880,10 +921,13 @@ class RemoteLeader(EventEmitter):
             None, socket.create_connection,
             (self.host, self.port), 10)
         self._sock.settimeout(None)     # RPCs keep blocking semantics
-        self._sock.sendall(_dump(('control', self._token)))
+        role = 'observer' if self.observer else None
+        self._sock.sendall(_dump(('control', self._token, None,
+                                  role)))
         reader, writer = await asyncio.open_connection(
             self.host, self.port)
-        writer.write(_dump(('events', self._token, self.have_zxid)))
+        writer.write(_dump(('events', self._token, self.have_zxid,
+                            role)))
         await writer.drain()
         self._events_writer = writer
         self._attached = asyncio.get_running_loop().create_future()
@@ -1025,7 +1069,18 @@ class RemoteLeader(EventEmitter):
                         self.wal.append(e)
             acked = self.log_end()
             acked_zxid = entry_zxid(self.log[-1]) if self.log else 0
+        if tail and self.observer \
+                and acked - self._acked_sent < self.OBS_ACK_BATCH:
+            # observer acks gate ONLY the leader's log-truncation
+            # floor (never a quorum), so they batch: one ack per
+            # OBS_ACK_BATCH ingested entries instead of one per
+            # commit — at read-plane fleet widths, per-commit acks
+            # from every observer made the leader process O(mirrors)
+            # messages per write.  The retained-log cost is bounded
+            # (< OBS_ACK_BATCH entries per observer).
+            return
         if tail and self._events_writer is not None:
+            self._acked_sent = acked
             # the ack rides the events transport, which belongs to the
             # loop: schedule the write there when called off-loop.
             # The piggybacked (applied_zxid, epoch) pair is the
@@ -1144,8 +1199,30 @@ class RemoteLeader(EventEmitter):
             return None
         return self._session(*res)
 
+    #: Floor on the touch-forward interval, seconds: even a tiny
+    #: session timeout must not turn every served request into a
+    #: leader RPC.
+    TOUCH_MIN_S = 0.1
+
+    #: Observer ack batching (:meth:`_ingest`): one truncation-floor
+    #: ack per this many ingested entries.  Voting followers always
+    #: ack per batch — their piggybacked zxid IS the quorum vote.
+    OBS_ACK_BATCH = 64
+
     def touch_session(self, sess: ZKServerSession) -> None:
-        # fire-and-forget: expiry timers live in the leader process
+        # Fire-and-forget (expiry timers live in the leader process)
+        # and RATE-LIMITED to a quarter of the session timeout — real
+        # ZK's learner forwards session activity at ping cadence, not
+        # per request.  Without the limit, every read served by a
+        # follower/observer costs the leader one control-channel
+        # message plus an expiry-timer reset: at read-plane scale the
+        # leader becomes the READ path's bottleneck even though it
+        # serves none of the reads.
+        now = time.monotonic()
+        if now - sess.last_touch_fwd < max(
+                self.TOUCH_MIN_S, sess.timeout / 4000.0):
+            return
+        sess.last_touch_fwd = now
         with self._lock:
             if self._sock is not None:
                 try:

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import time
 
 from ..protocol.consts import XID_NOTIFICATION, CreateFlag
 from ..protocol.errors import ZKProtocolError
@@ -47,6 +49,173 @@ ADMIN_WORDS = frozenset((b'ruok', b'mntr', b'stat', b'srvr', b'trce'))
 #: Member span-ring capacity: deep enough to hold a campaign's recent
 #: window (decode + per-txn chain + fan-out), fixed memory.
 MEMBER_RING_CAPACITY = 512
+
+# ---------------------------------------------------------------------
+# The zxid read gate: session-consistent reads off non-leader members.
+# ---------------------------------------------------------------------
+
+METRIC_READ_GATE_WAIT = 'zookeeper_read_gate_wait_ms'
+READ_GATE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     25.0, 50.0, 100.0, 250.0)
+
+#: How long a gated read may block waiting for this member to apply
+#: the session's floor before it BOUNCES (a typed CONNECTION_LOSS the
+#: client retries on a fresher member) — the read plane's analogue of
+#: the quorum gate's degrade window: a parked replica must delay
+#: reads, never wedge them (``ZKSTREAM_READ_GATE_WAIT_MS``).
+DEFAULT_READ_GATE_WAIT_MS = 100.0
+
+
+def read_gate_enabled() -> bool:
+    """Global kill switch (``ZKSTREAM_NO_READ_GATE=1``): the ungated
+    read path stays available as the env-gated validator arm — the
+    one ``analysis/linearize.py check_session_reads`` exists to
+    catch."""
+    return os.environ.get('ZKSTREAM_NO_READ_GATE') != '1'
+
+
+def read_gate_wait_ms() -> float:
+    try:
+        v = float(os.environ.get('ZKSTREAM_READ_GATE_WAIT_MS', ''))
+    except ValueError:
+        return DEFAULT_READ_GATE_WAIT_MS
+    return v if v > 0 else DEFAULT_READ_GATE_WAIT_MS
+
+
+def observers_default() -> int:
+    """Default observer count for a new ``ZKEnsemble``
+    (``ZKSTREAM_OBSERVERS``)."""
+    try:
+        n = int(os.environ.get('ZKSTREAM_OBSERVERS', ''))
+    except ValueError:
+        return 0
+    return max(0, n)
+
+
+class ReadGate:
+    """Session-consistent follower/observer reads (README "Read
+    plane"): a read must never show a session state OLDER than what
+    the session has already observed.  Every reply header stamps the
+    serving member's applied zxid into ``session.last_zxid`` (the
+    handshake's ``lastZxidSeen`` seeds it), and a read arriving at a
+    member whose store trails that floor parks here — re-dispatched
+    the moment the member's replica applies through the floor, or
+    bounced with a typed CONNECTION_LOSS after ``wait_ms`` so the
+    client can retry on a fresher member.  Leader-view members
+    (``store is db``) are always current and never gate.
+
+    Observability: ``zk_read_zxid_gate_blocks`` / ``_bounces`` mntr
+    rows, the ``zookeeper_read_gate_wait_ms`` histogram, and a
+    READ_GATE span per gated read in the member's trace ring."""
+
+    def __init__(self, server: 'ZKServer', collector=None,
+                 wait_ms: float | None = None):
+        self.server = server
+        self.wait_ms = (wait_ms if wait_ms is not None
+                        else read_gate_wait_ms())
+        self.blocks = 0
+        self.bounces = 0
+        #: parked reads: [floor, conn, pkt, t0, timer_handle]
+        self._pending: list = []
+        self._store = None
+        self._hist = None
+        if collector is not None:
+            self._hist = collector.histogram(
+                METRIC_READ_GATE_WAIT,
+                'Zxid read-gate wait before serve or bounce, ms',
+                buckets=READ_GATE_BUCKETS)
+
+    def defer(self, conn, pkt: dict, floor: int) -> None:
+        """Park one read whose serving member trails the session
+        floor.  The store-event subscription (one listener set per
+        member, armed lazily) re-dispatches it when the replica
+        applies through the floor; the timer bounds the wait."""
+        self.blocks += 1
+        self._subscribe()
+        from ..utils.aio import ambient_loop
+        entry = [floor, conn, pkt, time.perf_counter(), None]
+        entry[4] = ambient_loop().call_later(
+            self.wait_ms / 1000.0, self._bounce, entry)
+        self._pending.append(entry)
+
+    # -- store following (survives repoint) --
+
+    def _subscribe(self) -> None:
+        store = self.server.store
+        if self._store is store:
+            return
+        self._unsubscribe()
+        self._store = store
+        for ev in ('created', 'deleted', 'dataChanged',
+                   'childrenChanged'):
+            store.on(ev, self._on_store_event)
+
+    def _unsubscribe(self) -> None:
+        if self._store is None:
+            return
+        for ev in ('created', 'deleted', 'dataChanged',
+                   'childrenChanged'):
+            self._store.remove_listener(ev, self._on_store_event)
+        self._store = None
+
+    def _on_store_event(self, _path, _zxid) -> None:
+        if self._pending:
+            self._drain()
+
+    def _settle(self, entry, *, bounced: bool) -> None:
+        floor, conn, pkt, t0, timer = entry
+        if timer is not None:
+            timer.cancel()
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        if self._hist is not None:
+            self._hist.observe(dur_ms)
+        trace = self.server.trace
+        if trace is not None:
+            trace.note('READ_GATE', pkt.get('path'), zxid=floor,
+                       kind='server',
+                       detail='bounce' if bounced else 'block',
+                       duration_ms=round(dur_ms, 3))
+
+    def _drain(self) -> None:
+        """Re-dispatch every parked read the member has caught up
+        past, in arrival order (runs inside the store's apply, the
+        same dispatch point as watch fan-out)."""
+        z = self.server.store.zxid
+        ready = [e for e in self._pending if e[0] <= z]
+        if not ready:
+            return
+        self._pending = [e for e in self._pending if e[0] > z]
+        for entry in ready:
+            self._settle(entry, bounced=False)
+            conn, pkt = entry[1], entry[2]
+            if conn.closed:
+                continue
+            conn._handle_request(pkt)
+
+    def _bounce(self, entry) -> None:
+        """The bounded wait expired with the member still behind: a
+        typed CONNECTION_LOSS reply — outcome-unknown to the client's
+        ambiguity accounting, retryable on a fresher member — never a
+        stale payload."""
+        if entry not in self._pending:
+            return
+        self._pending.remove(entry)
+        entry[4] = None              # the timer IS this callback
+        self.bounces += 1
+        self._settle(entry, bounced=True)
+        conn, pkt = entry[1], entry[2]
+        if not conn.closed:
+            conn._reply(pkt['xid'], pkt['opcode'],
+                        err='CONNECTION_LOSS')
+
+    def reset(self) -> None:
+        """Drop every parked read (repoint/stop: the connections are
+        being closed; their sessions re-dial and retry)."""
+        pending, self._pending = self._pending, []
+        for entry in pending:
+            if entry[4] is not None:
+                entry[4].cancel()
+        self._unsubscribe()
 
 
 class ServerConnection:
@@ -173,8 +342,13 @@ class ServerConnection:
             return
         # the header zxid is this MEMBER's last applied transaction —
         # a lagging follower honestly reports its own position
-        pkt = {'xid': xid, 'zxid': self.store.zxid, 'err': err,
-               'opcode': opcode}
+        z = self.store.zxid
+        sess = self.session
+        if sess is not None and z > sess.last_zxid:
+            # the session has now SEEN this member state: the zxid
+            # read gate's floor (ReadGate) advances with every reply
+            sess.last_zxid = z
+        pkt = {'xid': xid, 'zxid': z, 'err': err, 'opcode': opcode}
         pkt.update(body)
         self._send(pkt)
 
@@ -427,6 +601,14 @@ class ServerConnection:
             # Session migration: drop the previous serving connection.
             if sess.owner is not None and sess.owner is not self:
                 sess.owner.close()
+        # the handshake's lastZxidSeen seeds the zxid read-gate floor:
+        # what this session observed through OTHER members (or a
+        # previous session of the same client) must not be readable
+        # backwards here — the cross-process half of the session-view
+        # contract (in-process members share the session object)
+        seen = pkt.get('lastZxidSeen', 0)
+        if seen > sess.last_zxid:
+            sess.last_zxid = seen
         sess.owner = self
         self.session = sess
         self._send({'protocolVersion': 0, 'timeOut': sess.timeout,
@@ -466,6 +648,23 @@ class ServerConnection:
         if fence is not None and fence():
             raise ZKOpError('EPOCH_FENCED')
 
+    def _gated(self, pkt: dict) -> bool:
+        """True when the zxid read gate parked this read: the serving
+        member's replica trails what this session has already seen, so
+        serving now could show the session an older state.  The gate
+        re-dispatches the packet once the replica catches up, or
+        bounces it after the bounded wait (ReadGate).  Leader-view
+        members are always current; ``ZKSTREAM_NO_READ_GATE=1`` keeps
+        the ungated path as the env-gated validator arm."""
+        gate = self.server.read_gate
+        if gate is None or self.store is self.db:
+            return False
+        floor = self.session.last_zxid
+        if self.store.zxid >= floor:
+            return False
+        gate.defer(self, pkt, floor)
+        return True
+
     def _op_create(self, pkt: dict) -> None:
         self._check_fence()
         path = self.db.create(pkt['path'], pkt['data'], pkt['acl'],
@@ -483,6 +682,8 @@ class ServerConnection:
         self._reply(pkt['xid'], 'DELETE')
 
     def _op_get_data(self, pkt: dict) -> None:
+        if self._gated(pkt):
+            return
         try:
             data, stat = self.store.get_data(pkt['path'])
         except ZKOpError:
@@ -498,6 +699,8 @@ class ServerConnection:
         self._reply(pkt['xid'], 'SET_DATA', stat=stat)
 
     def _op_exists(self, pkt: dict) -> None:
+        if self._gated(pkt):
+            return
         try:
             stat = self.store.exists(pkt['path'])
         except ZKOpError:
@@ -511,12 +714,16 @@ class ServerConnection:
         self._reply(pkt['xid'], 'EXISTS', stat=stat)
 
     def _op_get_children(self, pkt: dict) -> None:
+        if self._gated(pkt):
+            return
         children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
             self._arm_child(pkt['path'])
         self._reply(pkt['xid'], 'GET_CHILDREN', children=children)
 
     def _op_get_children2(self, pkt: dict) -> None:
+        if self._gated(pkt):
+            return
         children, stat = self.store.get_children(pkt['path'])
         if pkt.get('watch'):
             self._arm_child(pkt['path'])
@@ -524,6 +731,8 @@ class ServerConnection:
                     stat=stat)
 
     def _op_get_acl(self, pkt: dict) -> None:
+        if self._gated(pkt):
+            return
         acl, stat = self.store.get_acl(pkt['path'])
         self._reply(pkt['xid'], 'GET_ACL', acl=acl, stat=stat)
 
@@ -792,6 +1001,15 @@ class ZKServer:
         #: its ReplicationService's.  None = fsync-only barrier (the
         #: standalone / validator arm).
         self.quorum = None
+        #: Zxid read gate (README "Read plane"): reads through this
+        #: member park until its replica has applied everything the
+        #: session already observed, or bounce after the bounded wait
+        #: — the session view never goes backwards
+        #: (analysis/linearize.py check_session_reads is the
+        #: acceptance).  None = ``ZKSTREAM_NO_READ_GATE=1``, the
+        #: env-gated ungated validator the checker must catch.
+        self.read_gate = (ReadGate(self, collector=collector)
+                          if read_gate_enabled() else None)
 
     @property
     def ack_barrier(self):
@@ -891,6 +1109,8 @@ class ZKServer:
             # listeners first: no accept can land between severing
             # the fleet and releasing the port
             self.ingress.stop()
+        if self.read_gate is not None:
+            self.read_gate.reset()   # parked reads die with the conns
         for conn in list(self.conns):
             conn.close()
         self.conns.clear()
@@ -992,6 +1212,10 @@ class ZKServer:
         for conn in list(self.conns):
             conn.close()
         self.conns.clear()
+        if self.read_gate is not None:
+            # parked reads belonged to the closed connections; the
+            # gate re-follows the new store lazily
+            self.read_gate.reset()
         self.db.remove_listener('sessionExpired',
                                 self._on_session_expired)
         self.db = db
@@ -1038,6 +1262,14 @@ class ZKServer:
             ('zk_quorum_zxid', '0x%x' % (q.quorum_zxid_floor,)),
             ('zk_quorum_degraded', q.degraded_releases),
             ('zk_quorum_stale_acks', q.stale_acks),
+        ]
+        # zxid read-gate rows (README "Read plane"): reads parked
+        # until this member caught up, and parked reads bounced to a
+        # fresher member after the bounded wait
+        rg = self.read_gate
+        gate_rows = [] if rg is None else [
+            ('zk_read_zxid_gate_blocks', rg.blocks),
+            ('zk_read_zxid_gate_bounces', rg.bounces),
         ]
         # MULTI rows: batches applied and mean batch width
         batches = getattr(self.db, 'multi_batches', 0)
@@ -1093,8 +1325,8 @@ class ZKServer:
             ('zk_ingress_backend',
              'asyncio' if self.ingress is None
              else self.ingress.backend),
-        ] + self._ingress_census_rows() + multi_rows + quorum_rows \
-            + tick_rows + wal_rows
+        ] + self._ingress_census_rows() + multi_rows + gate_rows \
+            + quorum_rows + tick_rows + wal_rows
 
     def _ingress_census_rows(self) -> list[tuple[str, object]]:
         """Per-shard connection census (sharded ingress only): how
@@ -1171,7 +1403,8 @@ class ZKEnsemble:
                  seed: int | None = None,
                  transport: str | None = None,
                  quorum: bool | None = None,
-                 ingress_shards: int | None = None):
+                 ingress_shards: int | None = None,
+                 observers: int | None = None):
         #: One WAL for the whole ensemble, attached to the shared
         #: leader database (followers hold replica views of the same
         #: history; a per-member log would just write it N times).
@@ -1194,6 +1427,18 @@ class ZKEnsemble:
         #: push-time stamp must run ahead of the stores' synchronous
         #: applies on the 'committed' edge, or every zk_quorum_ack_ms
         #: sample would measure the gap to the NEXT commit instead.
+        #: The read scale-out plane (README "Read plane"): the VOTING
+        #: membership is members ``0..count-1``; ``observers`` extra
+        #: members receive the same replication feed and serve
+        #: reads/watches/sessions but never vote, never count toward
+        #: the quorum-commit majority, and never win an election — so
+        #: read capacity scales without widening the write quorum.
+        self.voters = count
+        self.observer_count = (observers if observers is not None
+                               else observers_default())
+        #: Quorum-commit: the ack barrier's membership is the VOTERS
+        #: alone — attaching observers must not widen (or shrink) the
+        #: majority a write waits for.
         from .replication import QuorumGate
         self.quorum = QuorumGate(self.db, count, enabled=quorum,
                                  collector=collector)
@@ -1207,19 +1452,26 @@ class ZKEnsemble:
                      watchtable=watchtable, member=str(i),
                      transport=transport,
                      ingress_shards=ingress_shards)
-            for i in range(count)]
+            for i in range(count + self.observer_count)]
+        for s in self.servers[count:]:
+            # an observer owns its own replica, watch table and
+            # ingress shards (notification fan-out and receive drain
+            # scale with the observer fleet), but its role never
+            # changes: elections are the voters' business
+            s.role = 'observer'
         #: Quorum leader election (server/election.py): on by default;
         #: ``election=False`` / ``ZKSTREAM_NO_ELECTION=1`` keeps the
         #: static member-0 leader as the env-gated validator path.
         #: The coordinator probes leader liveness on a jittered
         #: backoff and elects the highest (epoch, zxid, member) among
-        #: live, unpartitioned members when a quorum is reachable.
+        #: live, unpartitioned VOTERS when a quorum is reachable —
+        #: observers never enter a ballot.
         from .election import ElectionCoordinator, election_enabled
         enabled_election = (election_enabled() if election is None
                             else election)
         self.election = (ElectionCoordinator(
             self.servers, self.db, heartbeat_ms=heartbeat_ms,
-            seed=seed, collector=collector)
+            seed=seed, collector=collector, voters=count)
             if enabled_election else None)
         #: Quorum-commit wiring (server/replication.py QuorumGate,
         #: constructed above the servers list): the leader's ack
@@ -1275,6 +1527,14 @@ class ZKEnsemble:
         self.quorum.close()
         for s in self.servers:
             await s.stop()
+        # full-ensemble death: in-flight expiry timers die with it —
+        # one firing after the WAL below closes would try to log the
+        # session_close edge into a closed log (the read plane's
+        # per-backend read sessions made this race common at teardown)
+        for sess in self.db.sessions.values():
+            if sess.expiry_handle is not None:
+                sess.expiry_handle.cancel()
+                sess.expiry_handle = None
         if self.db.wal is not None:
             self.db.wal.close()
 
@@ -1287,7 +1547,9 @@ class ZKEnsemble:
         with election on, an ex-leader rejoins the CURRENT epoch as a
         follower, never as the leader it once was."""
         await self.servers[idx].restart()
-        if self.election is not None:
+        if idx >= self.voters:
+            self.servers[idx].role = 'observer'
+        elif self.election is not None:
             self.election.note_restart(idx)
 
     def addresses(self) -> list[tuple[str, int]]:

@@ -94,6 +94,23 @@ class ZKServerSession:
     #: The server connection currently serving this session, if any.
     owner: object = None
     expiry_handle: asyncio.TimerHandle | None = None
+    #: The newest member zxid this session has provably observed — the
+    #: max of every reply header it was sent plus the ``lastZxidSeen``
+    #: it presented at each handshake.  The zxid read gate
+    #: (server/server.py ReadGate) refuses to serve this session's
+    #: reads from a member behind this floor: the session view must
+    #: never go backwards (analysis/linearize.py check_session_reads).
+    #: In-process ensembles share the session OBJECT across members,
+    #: so the floor survives migration by construction; cross-process
+    #: members learn it from the handshake.
+    last_zxid: int = 0
+    #: When this member last FORWARDED a touch for this session to
+    #: its leader (monotonic seconds; cross-process members only).
+    #: Touch forwarding is rate-limited to a fraction of the session
+    #: timeout — real ZK's learner ping cadence — because a
+    #: per-request touch RPC would make the leader the read plane's
+    #: bottleneck (server/replication.py RemoteLeader.touch_session).
+    last_touch_fwd: float = 0.0
 
 
 def parent_path(path: str) -> str:

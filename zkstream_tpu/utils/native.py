@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 8
+_EXT_ABI_VERSION = 9
 
 _ext = None
 _ext_load_failed = False
@@ -209,24 +209,27 @@ _EXT_LAYOUTS = {
     'CLOSE_SESSION': 0, 'AUTH': 0,
     'GET_CHILDREN': 1, 'GET_CHILDREN2': 2, 'CREATE': 3, 'GET_ACL': 4,
     'GET_DATA': 5, 'EXISTS': 6, 'SET_DATA': 6, 'NOTIFICATION': 7,
+    'MULTI': 8,
 }
 
 #: opcode -> request-body-layout enum (keep in sync with
 #: records._REQ_READERS): 0 empty, 1 path, 2 path+watch, 3 create,
-#: 4 delete, 5 set_data, 6 set_watches.
+#: 4 delete, 5 set_data, 6 set_watches, 7 multi.
 _EXT_REQ_LAYOUTS = {
     'GET_CHILDREN': 2, 'GET_CHILDREN2': 2, 'GET_DATA': 2, 'EXISTS': 2,
     'CREATE': 3, 'DELETE': 4, 'GET_ACL': 1, 'SET_DATA': 5, 'SYNC': 1,
-    'SET_WATCHES': 6, 'CLOSE_SESSION': 0, 'PING': 0,
+    'SET_WATCHES': 6, 'CLOSE_SESSION': 0, 'PING': 0, 'MULTI': 7,
 }
 
 #: Opcodes the spec tier decodes but the extension deliberately PUNTS
 #: (decode_stream returns kind='UNSUPPORTED' at the frame boundary and
 #: PacketCodec hands the rest of the buffer to the Python spec tier).
-#: MULTI's variable-shape header/body framing is batch-rare and not
-#: worth a C layout; the sync test in tests/test_native_ext.py holds
-#: ``layouts | punts == spec readers``.
-_EXT_PUNT_OPS = frozenset(('MULTI',))
+#: Empty since the MULTI layouts landed (the PR 12 carry closed):
+#: every spec reader has a C layout in both directions; the punt
+#: MACHINERY stays for the next variable-shape opcode.  The sync test
+#: in tests/test_native_ext.py holds ``layouts | punts == spec
+#: readers``; byte-identical MULTI A/B lives in tests/test_multi.py.
+_EXT_PUNT_OPS = frozenset()
 
 
 def ext_setup_args() -> tuple:
