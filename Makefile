@@ -8,9 +8,10 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check analyze native bench asan ubsan sanitize \
     chaos chaos-ensemble obs durability election linearize \
+    reconfig \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
-    bench-read \
+    bench-read bench-reconfig \
     timeline coverage clean
 
 all: check test
@@ -70,6 +71,17 @@ election:
 	$(PYTHON) -m pytest tests/test_process_ensemble.py -q \
 	    -k 'election or member_worker'
 
+# Dynamic-membership suite (README "Dynamic membership"): the
+# reconfig unit/property tests — joint-majority arithmetic, removed-
+# voter fencing, observer join under write load (byte-identical
+# replica), WAL-recovered in-progress reconfig, resolver rebalance —
+# plus reconfig-enabled chaos slices on both tiers (per-era voter
+# replaces and a full-ensemble SIGKILL mid-joint-window on the
+# OS-process tier).  Rerun any seed with `python -m zkstream_tpu
+# chaos --tier ensemble --reconfig --seed N` (or --tier process).
+reconfig:
+	$(PYTHON) -m pytest tests/test_reconfig.py -q -m 'not slow'
+
 # Failover-time envelope: paired leader-kill cells at 3- vs 5-member
 # in-process ensembles — kill the leader, time detection -> elected
 # successor (zk_election_ms) and the client-observed failover (kill
@@ -86,6 +98,16 @@ bench-election:
 # ZKSTREAM_BENCH_QUORUM_ROUNDS.
 bench-quorum:
 	$(PYTHON) bench.py --quorum
+
+# Dynamic-membership cost envelope: per-round adjacent write cells
+# on one 3-voter ensemble — steady state vs during an observer join
+# vs during a voter replace — with exact sign tests against the
+# steady arm and join/replace duration percentiles (table in
+# PROFILE.md "Reconfiguration").  The bar: the observer-join arm
+# must NOT be significantly slower (an observer never widens the
+# write quorum).  Rounds via ZKSTREAM_BENCH_RECONFIG_ROUNDS.
+bench-reconfig:
+	$(PYTHON) bench.py --reconfig
 
 # Paired durability-cost envelope: wal-off vs sync=tick (group
 # commit) vs sync=always write-heavy cells at fleet 16/64 with

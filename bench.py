@@ -1233,6 +1233,148 @@ def bench_election() -> None:
         }), flush=True)
 
 
+#: `bench.py --reconfig` per-arm write counts: each arm keeps
+#: writing until the concurrent membership change completes, with at
+#: least MIN and at most CAP acked sets, so the paired p50s compare
+#: like against like while the cell stays bounded.
+RECONFIG_MIN_OPS = 60
+RECONFIG_CAP_OPS = 400
+
+
+async def _reconfig_round(idx: int) -> dict:
+    """One dynamic-membership cell: fresh 3-voter + 1-observer
+    in-process ensemble, one client writing sequentially.  Three
+    adjacent arms on the same ensemble: steady state, during an
+    OBSERVER JOIN (snapshot bootstrap + attach + CONTROL record),
+    and during a VOTER REPLACE (joint-majority handoff).  Returns
+    per-arm write p50 plus the wall duration of each change."""
+    import asyncio as aio
+    import time as _t
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.server import ZKEnsemble
+
+    ens = await ZKEnsemble(3, observers=1, seed=300 + idx).start()
+    c = Client(servers=ens.addresses(), shuffle_backends=False,
+               session_timeout=8000)
+    c.start()
+
+    def p50(lats: list) -> float:
+        return sorted(lats)[len(lats) // 2]
+
+    async def burst(until=None) -> list:
+        """Sequential acked sets; with ``until`` keeps writing while
+        the membership change runs (>= MIN, <= CAP ops)."""
+        lats = []
+        i = 0
+        while True:
+            t0 = _t.perf_counter()
+            await c.set('/rw', b'x%d' % (i,), version=-1)
+            lats.append((_t.perf_counter() - t0) * 1000.0)
+            i += 1
+            if until is None:
+                if i >= RECONFIG_MIN_OPS:
+                    return lats
+            elif (until.done() and i >= RECONFIG_MIN_OPS) \
+                    or i >= RECONFIG_CAP_OPS:
+                return lats
+
+    try:
+        await c.wait_connected(timeout=10)
+        await c.create('/rw', b'w')
+        steady = await burst()
+        t0 = _t.perf_counter()
+        join = aio.ensure_future(ens.add_observer())
+        during_join = await burst(until=join)
+        await join
+        join_ms = (_t.perf_counter() - t0) * 1000.0
+        t0 = _t.perf_counter()
+        rep = aio.ensure_future(ens.replace_voter(2))
+        during_replace = await burst(until=rep)
+        await rep
+        replace_ms = (_t.perf_counter() - t0) * 1000.0
+        return {'steady_p50_ms': round(p50(steady), 3),
+                'join_p50_ms': round(p50(during_join), 3),
+                'replace_p50_ms': round(p50(during_replace), 3),
+                'observer_join_ms': round(join_ms, 3),
+                'voter_replace_ms': round(replace_ms, 3),
+                'config_version': ens.db.config_version}
+    finally:
+        await c.close()
+        await ens.stop()
+
+
+def bench_reconfig() -> None:
+    """The dynamic-membership cost envelope (`make bench-reconfig`):
+    per-round adjacent steady / during-observer-join /
+    during-voter-replace write cells on one ensemble, exact
+    two-sided sign tests against the steady arm.  The acceptance bar
+    (README "Dynamic membership") is that the OBSERVER JOIN arm is
+    NOT significantly slower — an observer never widens the write
+    quorum, so attaching one must not tax the write path.  The voter
+    replace arm is reported without a bar: a joint window briefly
+    holds writes to two majorities by design.  Rounds via
+    ZKSTREAM_BENCH_RECONFIG_ROUNDS."""
+    import asyncio
+
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_RECONFIG_ROUNDS',
+                                '10'))
+    rows: dict = {'steady': [], 'join': [], 'replace': []}
+    durs: dict = {'observer_join_ms': [], 'voter_replace_ms': []}
+    paired: list = []
+    for rnd in range(rounds):
+        try:
+            r = asyncio.run(_reconfig_round(rnd))
+        except Exception as e:
+            print('# reconfig round %d failed: %r' % (rnd, e),
+                  file=sys.stderr)
+            continue
+        print('# reconfig_cell %s' % json.dumps(r), file=sys.stderr)
+        rows['steady'].append(r['steady_p50_ms'])
+        rows['join'].append(r['join_p50_ms'])
+        rows['replace'].append(r['replace_p50_ms'])
+        durs['observer_join_ms'].append(r['observer_join_ms'])
+        durs['voter_replace_ms'].append(r['voter_replace_ms'])
+        paired.append((r['steady_p50_ms'], r['join_p50_ms'],
+                       r['replace_p50_ms']))
+    for arm in ('steady', 'join', 'replace'):
+        if rows[arm]:
+            p50, p99 = _percentiles(rows[arm])
+            print(json.dumps({
+                'metric': 'reconfig_write_p50_ms',
+                'arm': arm,
+                'rounds': len(rows[arm]),
+                'p50_ms': round(p50, 3),
+                'p99_ms': round(p99, 3),
+            }), flush=True)
+    for name, vals in durs.items():
+        if vals:
+            p50, p99 = _percentiles(vals)
+            print(json.dumps({
+                'metric': name, 'rounds': len(vals),
+                'p50_ms': round(p50, 3), 'p99_ms': round(p99, 3),
+            }), flush=True)
+    for arm, col in (('join', 1), ('replace', 2)):
+        if not paired:
+            continue
+        wins = sum(1 for t in paired if t[col] > t[0])   # arm slower
+        losses = sum(1 for t in paired if t[col] < t[0])
+        deltas = [(t[col] - t[0]) / t[0] * 100.0
+                  for t in paired if t[0]]
+        print(json.dumps({
+            'metric': 'reconfig_%s_sign_test' % (arm,),
+            'pair': 'steady-vs-during-%s' % (arm,),
+            'rounds': len(paired),
+            'slower': wins,
+            'faster': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --quorum` ensemble sizes (the acceptance envelope:
 #: quorum-on must not be significantly slower than quorum-off at
 #: either membership — with synchronous in-process replicas the gate
@@ -2621,6 +2763,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_quorum()
+        return
+    if '--reconfig' in sys.argv:
+        # `make bench-reconfig`: the dynamic-membership cost family
+        # (steady vs during-observer-join vs during-voter-replace
+        # write p50s, paired sign tests).  Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_reconfig()
         return
     if '--traceov' in sys.argv:
         # `make bench-trace`: the paired trace-plane overhead family

@@ -292,6 +292,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help='which admin word to send (default mntr; '
                          'trce dumps the member span ring as JSON)')
 
+    rc = sub.add_parser(
+        'reconfig',
+        help='dynamic membership admin (README "Dynamic '
+             'membership"): show or change the ensemble '
+             'voter/observer sets at runtime over the rcfg admin '
+             'channel (raw TCP, no session)')
+    rc.add_argument('action', nargs='?', default='status',
+                    choices=('status', 'propose', 'commit', 'apply'),
+                    help='status scrapes every --server member; '
+                         'propose lands the reconfig record (the '
+                         'JOINT record for a voter change) and '
+                         'stops; commit finishes an open joint '
+                         'window; apply = propose + await joint '
+                         'quorum + commit + await final quorum '
+                         '(mutating actions walk --server until a '
+                         'member answers as leader)')
+    rc.add_argument('voters', nargs='?', default=None,
+                    help='comma-separated member ids of the NEW '
+                         'voter set (propose/apply)')
+    rc.add_argument('observers', nargs='?', default=None,
+                    help='comma-separated member ids of the new '
+                         'observer set ("-" for none; default: '
+                         'current observers minus any promoted '
+                         'member)')
+
     tl = sub.add_parser(
         'timeline',
         help='render a merged zxid-ordered causal timeline: one '
@@ -407,6 +432,20 @@ def build_parser() -> argparse.ArgumentParser:
                          'under test.  Part of the rerun key like '
                          '--clients.  Default: drawn per seed '
                          '(ensemble tier) / 0 (process tier)')
+    ch.add_argument('--reconfig', action='store_true',
+                    help='force membership reconfigurations into '
+                         'every schedule (README "Dynamic '
+                         'membership"): the ensemble/concurrent '
+                         'tiers draw forced reconfig steps (observer '
+                         'join/leave, voter add/remove/replace with '
+                         'joint-majority handoff; the first step is '
+                         'always a voter replace), the process tier '
+                         'drives a fenced voter replace per elected '
+                         'era plus one full-ensemble SIGKILL '
+                         'mid-joint recovered from WAL CONTROL '
+                         'records.  Part of the rerun key like '
+                         '--clients/--observers.  Default: drawn '
+                         'per seed (ensemble tiers) / off (process)')
     ch.add_argument('--elections', type=int, default=None,
                     help='ensemble tier: force N leader elections '
                          'per schedule (kill the current leader at '
@@ -484,6 +523,56 @@ async def _admin(args) -> int:
         if data and not data.endswith(b'\n'):
             sys.stdout.write('\n')
     return 1 if failed else 0
+
+
+async def _reconfig(args) -> int:
+    """Drive the ``rcfg`` dynamic-membership admin channel (README
+    "Dynamic membership") over raw TCP — no ZK session, like the
+    four-letter words.  ``status`` scrapes every --server member;
+    the mutating actions (propose/commit/apply) walk the member list
+    until one answers as leader, since only the leader may land
+    CONTROL records."""
+    if args.action in ('propose', 'apply') and not args.voters:
+        print('error: %s needs a voter list (comma-separated '
+              'member ids)' % (args.action,), file=sys.stderr)
+        return 2
+    line = args.action
+    if args.voters:
+        line += ' ' + args.voters
+        if args.observers:
+            line += ' ' + args.observers
+    if args.action == 'status':
+        failed = 0
+        many = len(args.server) > 1
+        for spec in args.server:
+            host, port = spec['address'], spec['port']
+            if many:
+                print('--- %s:%d ---' % (host, port))
+            try:
+                reply = await _admin_one(host, port, 'rcfg status\n',
+                                         args.timeout)
+            except (OSError, asyncio.TimeoutError, TimeoutError):
+                print('error: could not connect to %s:%d'
+                      % (host, port), file=sys.stderr)
+                failed += 1
+                continue
+            sys.stdout.write(reply.decode('utf-8', 'replace'))
+        return 1 if failed else 0
+    for spec in args.server:
+        host, port = spec['address'], spec['port']
+        try:
+            reply = (await _admin_one(
+                host, port, 'rcfg %s\n' % (line,),
+                args.timeout)).decode('utf-8', 'replace')
+        except (OSError, asyncio.TimeoutError, TimeoutError):
+            continue
+        if reply.startswith('error not leader'):
+            continue
+        sys.stdout.write(reply)
+        return 1 if reply.startswith('error') else 0
+    print('error: no member accepted %r (no reachable leader?)'
+          % (line,), file=sys.stderr)
+    return 1
 
 
 async def _chaos(args) -> int:
@@ -575,7 +664,11 @@ async def _chaos(args) -> int:
             progress=progress,
             elections=getattr(args, 'elections', None),
             clients=getattr(args, 'clients', None),
-            observers=getattr(args, 'observers', None))
+            observers=getattr(args, 'observers', None),
+            # --reconfig forces two steps per schedule; the FIRST
+            # executed step is always a voter replace (io/faults.py),
+            # so every campaign holds >= 1 joint-majority handoff
+            reconfigs=2 if getattr(args, 'reconfig', False) else None)
     elif args.tier == 'process':
         if getattr(args, 'no_election', False):
             # the process tier IS the election plane: there is no
@@ -591,7 +684,8 @@ async def _chaos(args) -> int:
             progress=progress,
             elections=getattr(args, 'elections', None),
             clients=getattr(args, 'clients', None),
-            observers=getattr(args, 'observers', None))
+            observers=getattr(args, 'observers', None),
+            reconfig=getattr(args, 'reconfig', False))
     else:
         if getattr(args, 'clients', None) and args.clients > 1:
             print('error: --clients needs the history-checked '
@@ -600,6 +694,11 @@ async def _chaos(args) -> int:
             return 2
         if getattr(args, 'observers', None):
             print('error: --observers needs an ensemble; use '
+                  '--tier ensemble or --tier process',
+                  file=sys.stderr)
+            return 2
+        if getattr(args, 'reconfig', False):
+            print('error: --reconfig needs an ensemble; use '
                   '--tier ensemble or --tier process',
                   file=sys.stderr)
             return 2
@@ -638,12 +737,14 @@ async def _chaos(args) -> int:
         clients = getattr(args, 'clients', None)
         observers = getattr(args, 'observers', None)
         print('failing seeds (rerun: python -m zkstream_tpu chaos '
-              '--tier %s%s%s --seed N --schedules 1): %s'
+              '--tier %s%s%s%s --seed N --schedules 1): %s'
               % (args.tier,
                  ' --clients %d' % (clients,)
                  if clients and clients > 1 else '',
                  ' --observers %d' % (observers,)
                  if observers else '',
+                 ' --reconfig'
+                 if getattr(args, 'reconfig', False) else '',
                  ', '.join(str(r.seed) for r in bad)),
               file=sys.stderr)
         return 1
@@ -800,6 +901,19 @@ def _wal(args) -> int:
                         len(entry[1]),
                         ', '.join('%s %s' % (s[0], s[1])
                                   for s in entry[1]))
+                elif entry[0] == 'reconfig':
+                    # the membership CONTROL record: a surviving
+                    # 'joint' with old_voters IS the crash-mid-window
+                    # signature recovery resumes from
+                    what = 'version=%d phase=%s voters=%s' % (
+                        entry[1], entry[2],
+                        ','.join(str(m) for m in entry[4]) or '-')
+                    if entry[3]:
+                        what += ' old_voters=%s' % (
+                            ','.join(str(m) for m in entry[3]),)
+                    if entry[5]:
+                        what += ' observers=%s' % (
+                            ','.join(str(m) for m in entry[5]),)
                 else:
                     what = entry[1]
                 print('    #%-6d zxid=%-6d %-8s %s%s'
@@ -871,6 +985,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == 'mntr':
         # raw four-letter-word scrape: no client, no session
         return asyncio.run(_admin(args))
+    if args.cmd == 'reconfig':
+        # raw rcfg admin line: no client, no session
+        return asyncio.run(_reconfig(args))
     if args.cmd == 'timeline':
         # self-contained demo (or raw trce scrape with --live):
         # never dials --server as a protocol client

@@ -34,7 +34,9 @@ from .io.pool import (
     DEFAULT_POLICY,
     ConnectionPool,
     ReadPlane,
+    Resolver,
     read_distribution_default,
+    read_subset_default,
 )
 from .io.session import ZKSession
 from .io.watcher import ZKWatcher
@@ -87,7 +89,9 @@ class Client(FSM):
                  cork: bool | None = None,
                  transport: str | None = None,
                  flush_cap: int | None = None,
-                 read_distribution: bool | None = None):
+                 read_distribution: bool | None = None,
+                 read_subset: int | None = None,
+                 resolver: Resolver | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -192,7 +196,21 @@ class Client(FSM):
         enabled_reads = (read_distribution_default()
                          if read_distribution is None
                          else read_distribution)
-        self._read_plane = (ReadPlane(self, backends)
+        #: Live member list (io/pool.py Resolver, README "Dynamic
+        #: membership"): ``update_backends()`` adopts a post-reconfig
+        #: fleet; the read plane rebalances its dialed subset on the
+        #: change while the primary session drains in place.
+        self.resolver = (resolver if resolver is not None
+                         else Resolver(backends))
+        self.resolver.on('changed',
+                         lambda bs: self.pool.set_backends(bs))
+        #: Read-plane subset cap: dial at most K read sessions from
+        #: the live config (None = one per backend; process default
+        #: via ``ZKSTREAM_READ_SUBSET``).
+        subset = (read_subset_default() if read_subset is None
+                  else (read_subset if read_subset > 0 else None))
+        self._read_plane = (ReadPlane(self, backends, subset=subset,
+                                      resolver=self.resolver)
                             if enabled_reads and len(backends) > 1
                             else None)
         #: The newest member zxid any DISTRIBUTED read has shown this
@@ -285,6 +303,15 @@ class Client(FSM):
             # waiting on cyclic GC (the plane/entry closures keep the
             # tier in a cycle); a reused client lazily re-creates it
             self.transport_tier.close()
+
+    def update_backends(self, backends) -> bool:
+        """Adopt a new live member list (README "Dynamic
+        membership"): Backend objects or (address, port) pairs.
+        The read plane rebalances its dialed subset immediately; the
+        primary session stays where it is until its connection dies,
+        then redials against the updated list.  Returns True when the
+        membership actually changed."""
+        return self.resolver.update(backends)
 
     # -- session management (reference: lib/client.js:187-273) --
 

@@ -1022,6 +1022,19 @@ class FaultPlan:
     #: invariant under test.  Part of the rerun key:
     #: ``chaos --observers N``.
     observers: int = 0
+    #: forced membership changes (README "Dynamic membership"):
+    #: evenly spaced plan steps each run one runtime reconfig under
+    #: traffic — the FIRST is always a voter REPLACE through a joint
+    #: window (the acceptance shape: both majorities must hold the
+    #: joint record), later steps draw from the fresh reconfig
+    #: stream.  Invariant 7's extension (check_reconfig) replays the
+    #: config records.  Part of the rerun key: ``chaos --reconfig N``.
+    reconfigs: int = 0
+    #: read-plane subset cap for the schedule's clients (the
+    #: ``ZKSTREAM_READ_SUBSET`` knob): drawn on the reconfig stream —
+    #: a subset-capped plane must rebalance correctly when the
+    #: resolver adopts a post-reconfig member list
+    read_subset: int | None = None
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -1051,6 +1064,12 @@ class FaultPlan:
         # still produces the same value
         obrng = random.Random('plan-observers/%d' % (seed,))
         plan.observers = obrng.choice([0, 0, 0, 1, 2])
+        # and for dynamic membership (PR 16): reconfig count and the
+        # read-plane subset cap ride one fresh stream, so every draw
+        # existing seeds pinned still produces the same value
+        rrng = random.Random('plan-reconfig/%d' % (seed,))
+        plan.reconfigs = rrng.choice([0, 0, 0, 1, 2])
+        plan.read_subset = rrng.choice([None, None, 2, 3])
         return plan
 
     def forced_election_steps(self) -> set[int]:
@@ -1069,6 +1088,15 @@ class FaultPlan:
             return set()
         return {((2 * k + 1) * self.ops) // (2 * self.multis + 1)
                 for k in range(self.multis)}
+
+    def forced_reconfig_steps(self) -> set[int]:
+        """The plan steps that run a forced membership change
+        (evenly spaced, before the drawn action; the first executed
+        is always a voter replace)."""
+        if self.reconfigs <= 0:
+            return set()
+        return {((k + 1) * self.ops) // (self.reconfigs + 1)
+                for k in range(self.reconfigs)}
 
 
 class EnsembleUnderTest:
@@ -1107,18 +1135,46 @@ class EnsembleUnderTest:
                                observers=observers)
         self.db = self._ens.db
         self.servers = self._ens.servers
-        #: voting membership: members at index >= voters are
-        #: observers (non-voting read-serving replicas)
-        self.voters = self._ens.voters
         self.coordinator = self._ens.election
         self.svc = ReplicationService(self.db)
         self.dead: set[int] = set()
+        #: members a reconfig removed from the ensemble outright
+        #: (observer leave): stopped and detached, never restarted
+        self.removed: set[int] = set()
         self.remote = None           # RemoteLeader (events/control)
         self.replica = None          # RemoteReplicaStore over it
 
     @property
     def leader_idx(self) -> int:
         return self._ens.leader_idx
+
+    @property
+    def voters(self) -> int:
+        """Voting-member count — live through reconfigs (the
+        ZKEnsemble re-derives it on every config change)."""
+        return self._ens.voters
+
+    def voter_idxs(self) -> list[int]:
+        """Current voter member indices, from the installed config
+        (after a reconfig they are no longer ``range(voters)``)."""
+        if getattr(self.db, 'voter_ids', None) is not None:
+            return sorted(self.db.voter_ids)
+        return list(range(self._ens.voters))
+
+    def observer_idxs(self) -> list[int]:
+        """Current observer member indices, from the installed
+        config."""
+        if getattr(self.db, 'voter_ids', None) is not None:
+            return sorted(self.db.observer_ids)
+        return list(range(self._ens.voters, len(self.servers)))
+
+    def config_addresses(self) -> list[tuple[str, int]]:
+        """The live config's member addresses (voters + observers) —
+        what a client resolver adopts after a membership change."""
+        idxs = sorted(set(self.voter_idxs())
+                      | set(self.observer_idxs()))
+        return [self.servers[i].address for i in idxs
+                if i < len(self.servers)]
 
     async def start(self) -> 'EnsembleUnderTest':
         from ..server.replication import (
@@ -1144,7 +1200,7 @@ class EnsembleUnderTest:
 
     def live(self) -> list[int]:
         return [i for i in range(len(self.servers))
-                if i not in self.dead]
+                if i not in self.dead and i not in self.removed]
 
     async def kill(self, idx: int) -> None:
         await self._ens.kill(idx)
@@ -1161,6 +1217,27 @@ class EnsembleUnderTest:
         self._ens.set_lag(idx, lag)
         if lag is not None and lag <= 0:
             self.servers[idx].store.catch_up()
+
+    # -- runtime membership changes (delegated to the ZKEnsemble so
+    # the two harnesses cannot drift) --
+
+    async def add_observer(self) -> int:
+        return await self._ens.add_observer()
+
+    async def remove_observer(self, idx: int) -> None:
+        await self._ens.remove_observer(idx)
+        self.removed.add(idx)
+
+    async def add_voter(self) -> int:
+        return await self._ens.add_voter()
+
+    async def remove_voter(self, idx: int) -> None:
+        # the demoted member drains on as an out-of-config observer
+        # (still killable, still serving) — not `removed`
+        await self._ens.remove_voter(idx)
+
+    async def replace_voter(self, old_idx: int) -> int:
+        return await self._ens.replace_voter(old_idx)
 
     def partition_replica(self) -> bool:
         """Toggle the scheduled asymmetric partition of the TCP
@@ -1182,12 +1259,105 @@ class EnsembleUnderTest:
         await self.svc.stop()
 
 
+#: The forced-reconfig action mix ('churn-reconfig' stream;
+#: repetition = weight).  The first executed step of every schedule
+#: bypasses the draw: it is always 'replace-voter', the full joint
+#: handoff the acceptance criteria pin.
+RECONFIG_ACTIONS = ('replace-voter', 'add-observer', 'add-observer',
+                    'remove-observer', 'add-voter', 'remove-voter')
+
+
+def _make_force_reconfig(ens, res, rrng, note_member,
+                         force_election, update_resolvers):
+    """Build the forced-reconfig step shared by the ensemble
+    schedules (single-client and concurrent): one membership change
+    under traffic per call.  The db's config-change hook (wrapped by
+    the caller) records every config record into the history, so
+    invariant 7's extension replays exactly what landed."""
+    done = {'k': 0}
+
+    async def force_reconfig() -> None:
+        db = ens.db
+        if getattr(db, 'voter_ids', None) is None \
+                or ens.coordinator is None:
+            return
+        k, done['k'] = done['k'], done['k'] + 1
+        # a joint commit needs majorities of BOTH configs audible:
+        # bring dead members back before opening the window
+        for back in sorted(ens.dead):
+            note_member('restart', back)
+            await ens.restart(back)
+        act = ('replace-voter' if k == 0
+               else rrng.choice(RECONFIG_ACTIONS))
+        voter_change = act not in ('add-observer',
+                                   'remove-observer')
+        if voter_change and db.reconfig_epoch == db.epoch:
+            # at most one voter-set change per epoch (invariant 7
+            # extension): a second change needs a fresh era — earn
+            # it the legitimate way, through an election
+            await force_election()
+            for back in sorted(ens.dead):
+                note_member('restart', back)
+                await ens.restart(back)
+        try:
+            if act == 'add-observer':
+                idx = await asyncio.wait_for(ens.add_observer(), 10)
+                note_member('reconfig-add-observer', idx)
+            elif act == 'remove-observer':
+                obs = [i for i in ens.observer_idxs()
+                       if i not in ens.dead and i not in ens.removed]
+                if not obs:
+                    return
+                idx = obs[rrng.randrange(len(obs))]
+                await asyncio.wait_for(ens.remove_observer(idx), 10)
+                note_member('reconfig-remove-observer', idx)
+            elif act == 'add-voter':
+                idx = await asyncio.wait_for(ens.add_voter(), 10)
+                note_member('reconfig-add-voter', idx)
+            elif act == 'remove-voter':
+                cands = [i for i in ens.voter_idxs()
+                         if i != ens.leader_idx]
+                if len(ens.voter_idxs()) <= 2 or not cands:
+                    return
+                idx = cands[rrng.randrange(len(cands))]
+                await asyncio.wait_for(ens.remove_voter(idx), 10)
+                note_member('reconfig-remove-voter', idx)
+            else:
+                cands = [i for i in ens.voter_idxs()
+                         if i != ens.leader_idx]
+                if not cands:
+                    return
+                old = cands[rrng.randrange(len(cands))]
+                idx = await asyncio.wait_for(
+                    ens.replace_voter(old), 10)
+                note_member('reconfig-replace-voter(%d->%d)'
+                            % (old, idx), idx)
+        except ValueError as e:
+            # a legal refusal (the per-epoch fence, an empty voter
+            # set): the fence HOLDING is the invariant — record it
+            # in the timeline and move on
+            note_member('reconfig-refused(%s)' % (e,), act)
+            return
+        except (asyncio.TimeoutError, TimeoutError):
+            res.violations.append(
+                'forced reconfig (%s) hung past 10s: joint quorum '
+                'never assembled' % (act,))
+            return
+        # the elastic client side: resolvers adopt the new member
+        # list, subset-capped read planes rebalance onto it
+        update_resolvers()
+        note_member('resolver-update', '-')
+
+    return force_reconfig
+
+
 async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 collector=None,
                                 plan: FaultPlan | None = None,
                                 elections: int | None = None,
                                 clients: int | None = None,
-                                observers: int | None = None
+                                observers: int | None = None,
+                                reconfigs: int | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
     client workload, every op recorded into an append-only history,
@@ -1203,7 +1373,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     if clients is not None and clients > 1:
         return await run_concurrent_schedule(
             seed, ops=ops, clients=clients, collector=collector,
-            plan=plan, elections=elections, observers=observers)
+            plan=plan, elections=elections, observers=observers,
+            reconfigs=reconfigs)
     from ..client import Client
     from ..protocol.consts import CreateFlag
     from .backoff import BackoffPolicy
@@ -1221,9 +1392,14 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         plan.elections = elections
     if observers is not None:
         plan.observers = observers
+    if reconfigs is not None:
+        plan.reconfigs = reconfigs
     #: observer churn draws ride their own stream (fresh per seed):
     #: attaching observers must not shift any draw existing seeds pin
     orng = random.Random('churn-obs/%d' % (seed,))
+    #: forced-reconfig draws (victim/action choice) — fresh stream,
+    #: same rule
+    rrng = random.Random('churn-reconfig/%d' % (seed,))
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble')
     h = History()
@@ -1235,6 +1411,20 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         wal_segment_bytes=plan.wal_segment_bytes, seed=seed,
         observers=plan.observers).start()
     ens.install_faults(inj)
+
+    # every config record — joint and final — lands in the history
+    # with the epoch it was appended under; check_reconfig (the
+    # invariant-7 extension) replays them.  Chained UNDER the
+    # ZKEnsemble's own hook, which re-derives the quorum/ballot sets.
+    _prev_cfg_hook = ens.db.on_config_change
+
+    def _on_cfg(phase, entry, _prev=_prev_cfg_hook):
+        if _prev is not None:
+            _prev(phase, entry)
+        h.reconfig(entry[1], entry[2], ens.db.epoch,
+                   voters=entry[4], old_voters=entry[3],
+                   observers=entry[5])
+    ens.db.on_config_change = _on_cfg
 
     ingest = None
     if plan.ingest_mode != 'none':
@@ -1253,6 +1443,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         # reads fan out across the whole membership, zxid-gated, and
         # check_session_reads holds the session-monotone rung
         read_distribution=plan.observers > 0,
+        read_subset=plan.read_subset,
         decoherence_interval=(plan.decoherence_ms
                               if plan.decoherence_ms is not None
                               else DEFAULT_DECOHERENCE_INTERVAL),
@@ -1280,8 +1471,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
     if ens.coordinator is None:
         # static-leader validator path (ZKSTREAM_NO_ELECTION=1 /
         # election=False): a drawn election count is meaningless here
-        # and must not read as a missed-election violation
+        # and must not read as a missed-election violation — and a
+        # reconfig's joint handoff has no election to lean on either
         plan.elections = 0
+        plan.reconfigs = 0
     else:
         # every completed election lands in the history (invariant 7
         # replays these) AND the client span ring, so a failing seed's
@@ -1305,9 +1498,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         the real one (heartbeat monitor), not a direct call."""
         if ens.coordinator is None:
             return
-        need = ens.voters // 2 + 1
+        voter_set = set(ens.voter_idxs())
+        need = len(voter_set) // 2 + 1
         while ens.dead and \
-                len([j for j in ens.live() if j < ens.voters]) - 1 \
+                len([j for j in ens.live() if j in voter_set]) - 1 \
                 < need:
             back = sorted(ens.dead)[0]
             note_member('restart', back)
@@ -1326,6 +1520,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             res.violations.append(
                 'forced election: no successor elected within 8s of '
                 'killing leader %d' % (lead,))
+
+    force_reconfig = _make_force_reconfig(
+        ens, res, rrng, note_member, force_election,
+        lambda: client.update_backends(ens.config_addresses()))
 
     def sid() -> int:
         for r in reversed(h.records):
@@ -1425,11 +1623,14 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
 
         forced_steps = plan.forced_election_steps()
         multi_steps = plan.forced_multi_steps()
+        reconfig_steps = plan.forced_reconfig_steps()
         for i in range(plan.ops):
             await wait_usable(1.5)
             res.ops += 1
             if i in forced_steps:
                 await force_election()
+            if i in reconfig_steps:
+                await force_reconfig()
             if i in multi_steps:
                 await do_multi(i)
             act = inj.choice('plan', PLAN_ACTIONS)
@@ -1501,8 +1702,11 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                     await ens.kill(victim)
             elif act == 'kill_follower':
                 # voters only: observer churn rides its own stream
+                # (the CONFIG's voter set — after a reconfig the
+                # voters are no longer ``range(voters)``)
+                voter_set = set(ens.voter_idxs())
                 live = [j for j in ens.live()
-                        if j != 0 and j < ens.voters]
+                        if j != 0 and j in voter_set]
                 if not live or len(ens.live()) <= 1:
                     continue
                 victim = inj.choice('plan', live)
@@ -1528,8 +1732,12 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 else:
                     note_member('heal', 'replica')
             elif act == 'lag':
+                # non-member-0 voters (same list as range(1, voters)
+                # until a reconfig moves the membership; same length
+                # either way, so the 'plan' stream stays aligned)
                 idx = inj.choice('plan',
-                                 range(1, ens.voters))
+                                 [j for j in ens.voter_idxs()
+                                  if j != 0])
                 lag = inj.choice('plan', (None, 0.05, 0.0))
                 note_member('lag=%r' % (lag,), idx)
                 ens.set_lag(idx, lag)
@@ -1547,7 +1755,16 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 oact = orng.choice(('none', 'none', 'lag', 'park',
                                     'heal'))
                 if oact != 'none':
-                    oidx = ens.voters + orng.randrange(plan.observers)
+                    # the CONFIG's observers (identical to
+                    # voters+range(observers) until a reconfig moves
+                    # the membership; one draw either way, so the
+                    # stream stays aligned)
+                    obs = [j for j in ens.observer_idxs()
+                           if j not in ens.removed]
+                    pick = orng.randrange(max(1, len(obs)))
+                    if not obs:
+                        continue
+                    oidx = obs[pick]
                     if oact == 'lag':
                         olag = orng.choice((0.05, 0.0))
                         note_member('observer-lag=%r' % (olag,), oidx)
@@ -1614,6 +1831,14 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
             res.violations.append(
                 'plan forced %d election(s) but only %d completed'
                 % (forced_n, elections_seen()))
+        # a forced reconfig may legally refuse (the per-epoch fence),
+        # but a plan that forces any must land at least one config
+        # record — the first step's voter replace has no fence to hit
+        if plan.forced_reconfig_steps() and \
+                not h.of_kind('reconfig'):
+            res.violations.append(
+                'plan forced %d reconfig step(s) but no config '
+                'record landed' % (plan.reconfigs,))
         res.violations.extend(check_history(h, ens.db))
 
         # -- durability: full-ensemble SIGKILL + restart-from-disk --
@@ -1696,18 +1921,21 @@ async def run_ensemble_campaign(base_seed: int, schedules: int,
                                 ops: int = 12, progress=None,
                                 elections: int | None = None,
                                 clients: int | None = None,
-                                observers: int | None = None
+                                observers: int | None = None,
+                                reconfigs: int | None = None
                                 ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
     starting at ``base_seed`` (``clients`` > 1: the concurrent
     tier, every schedule linearizability-checked; ``observers``
-    overrides every plan's non-voting member count)."""
+    overrides every plan's non-voting member count; ``reconfigs``
+    every plan's forced membership-change count)."""
     out = []
     for i in range(schedules):
         r = await run_ensemble_schedule(base_seed + i, ops=ops,
                                         elections=elections,
                                         clients=clients,
-                                        observers=observers)
+                                        observers=observers,
+                                        reconfigs=reconfigs)
         out.append(r)
         if progress is not None:
             progress(r)
@@ -1747,7 +1975,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                                   collector=None,
                                   plan: FaultPlan | None = None,
                                   elections: int | None = None,
-                                  observers: int | None = None
+                                  observers: int | None = None,
+                                  reconfigs: int | None = None
                                   ) -> ScheduleResult:
     """One seeded concurrent schedule: ``clients`` Clients driven
     from per-client RNG streams drawn fresh from the FaultPlan, each
@@ -1782,6 +2011,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         plan.elections = elections
     if observers is not None:
         plan.observers = observers
+    if reconfigs is not None:
+        plan.reconfigs = reconfigs
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble',
                          clients=clients)
@@ -1792,6 +2023,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     #: observer churn rides its own stream — attaching observers
     #: must not shift the per-client or churn draws existing seeds pin
     orng = random.Random('churn-obs/%d' % (seed,))
+    #: forced-reconfig draws — fresh stream, same rule
+    rrng = random.Random('churn-reconfig/%d' % (seed,))
 
     wal_dir = tempfile.mkdtemp(prefix='zkchaos-conc-wal-')
     crash_dir = tempfile.mkdtemp(prefix='zkchaos-conc-crash-')
@@ -1800,6 +2033,19 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         wal_segment_bytes=plan.wal_segment_bytes, seed=seed,
         observers=plan.observers).start()
     ens.install_faults(inj)
+
+    # config records land in the history with their epoch (the
+    # invariant-7 extension replays them) — chained under the
+    # ZKEnsemble's own quorum/ballot re-derivation hook
+    _prev_cfg_hook = ens.db.on_config_change
+
+    def _on_cfg(phase, entry, _prev=_prev_cfg_hook):
+        if _prev is not None:
+            _prev(phase, entry)
+        h.reconfig(entry[1], entry[2], ens.db.epoch,
+                   voters=entry[4], old_voters=entry[3],
+                   observers=entry[5])
+    ens.db.on_config_change = _on_cfg
 
     ingest = None
     if plan.ingest_mode != 'none':
@@ -1824,6 +2070,7 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
             # attached: distributed reads are zxid-gated and the
             # history must still pass check_session_reads
             read_distribution=plan.observers > 0,
+            read_subset=plan.read_subset,
             decoherence_interval=(plan.decoherence_ms
                                   if plan.decoherence_ms is not None
                                   else DEFAULT_DECOHERENCE_INTERVAL),
@@ -1853,6 +2100,7 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
 
     if ens.coordinator is None:
         plan.elections = 0
+        plan.reconfigs = 0
     else:
         def on_elected(member, epoch, dur_ms):
             h.election(member, epoch)
@@ -1869,9 +2117,10 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     async def force_election() -> None:
         if ens.coordinator is None:
             return
-        need = ens.voters // 2 + 1
+        voter_set = set(ens.voter_idxs())
+        need = len(voter_set) // 2 + 1
         while ens.dead and \
-                len([j for j in ens.live() if j < ens.voters]) - 1 \
+                len([j for j in ens.live() if j in voter_set]) - 1 \
                 < need:
             back = sorted(ens.dead)[0]
             note_member('restart', back)
@@ -1890,6 +2139,15 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
             res.violations.append(
                 'forced election: no successor elected within 8s '
                 'of killing leader %d' % (lead,))
+
+    def _update_resolvers() -> None:
+        addrs = ens.config_addresses()
+        for c in cls:
+            c.update_backends(addrs)
+
+    force_reconfig = _make_force_reconfig(
+        ens, res, rrng, note_member, force_election,
+        _update_resolvers)
 
     async def usable(c, timeout: float) -> bool:
         if c.is_connected():
@@ -2016,12 +2274,16 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
 
     async def churn() -> None:
         forced = plan.forced_election_steps()
+        reconfig_steps = plan.forced_reconfig_steps()
         for i in range(ops):
             if i in forced:
                 await force_election()
+            if i in reconfig_steps:
+                await force_reconfig()
             act = crng.choice(CONCURRENT_CHURN)
             if act == 'kill_any':
-                live = [j for j in ens.live() if j < ens.voters]
+                voter_set = set(ens.voter_idxs())
+                live = [j for j in ens.live() if j in voter_set]
                 if len(live) > 1:
                     victim = crng.choice(live)
                     note_member('kill', victim)
@@ -2042,7 +2304,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                 else:
                     note_member('heal', 'replica')
             elif act == 'lag':
-                idx = crng.choice(range(1, ens.voters))
+                idx = crng.choice([j for j in ens.voter_idxs()
+                                   if j != 0])
                 lag = crng.choice((None, 0.05, 0.0))
                 note_member('lag=%r' % (lag,), idx)
                 ens.set_lag(idx, lag)
@@ -2056,9 +2319,15 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                 oact = orng.choice(('none', 'none', 'lag', 'park',
                                     'heal'))
                 if oact != 'none':
-                    oidx = ens.voters \
-                        + orng.randrange(plan.observers)
-                    if oact == 'lag':
+                    # the CONFIG's observers (one draw either way,
+                    # so the stream stays aligned through reconfigs)
+                    obs = [j for j in ens.observer_idxs()
+                           if j not in ens.removed]
+                    pick = orng.randrange(max(1, len(obs)))
+                    oidx = obs[pick] if obs else None
+                    if oidx is None:
+                        pass
+                    elif oact == 'lag':
                         olag = orng.choice((0.05, 0.0))
                         note_member('observer-lag=%r' % (olag,),
                                     oidx)
@@ -2117,6 +2386,11 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
             res.violations.append(
                 'plan forced %d election(s) but only %d completed'
                 % (forced_n, elections_seen()))
+        if plan.forced_reconfig_steps() and \
+                not h.of_kind('reconfig'):
+            res.violations.append(
+                'plan forced %d reconfig step(s) but no config '
+                'record landed' % (plan.reconfigs,))
         # the full invariant engine, invariant 9 (per-key WGL
         # linearizability pinned to the final tree) included
         res.violations.extend(check_history(h, ens.db))

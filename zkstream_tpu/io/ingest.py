@@ -91,6 +91,33 @@ def _next_pow2(n: int) -> int:
 _MISSING = object()
 
 
+def _guard_warm_exit(thread: threading.Thread, q: queue.Queue) -> None:
+    """Interpreter-exit guard for one warm worker.  A compile's lazy
+    ``import jax`` racing jax's own atexit cache teardown in the main
+    thread leaves jax half-imported while ``clear_caches`` walks it —
+    observed as a segfault/abort at process exit the first time a
+    server spun up LATE in a run (e.g. a member added by a runtime
+    reconfiguration) queues its first bucket compile just before the
+    CLI returns.  ``threading._register_atexit`` callbacks run at
+    threading shutdown, BEFORE the atexit module's handlers — so
+    before jax's — where a BOUNDED join lets an in-flight compile
+    finish while a wedged one still cannot hang exit (the worker
+    stays a daemon).  Plain atexit is the (weaker) fallback when the
+    private hook is missing."""
+    def _drain_and_join() -> None:
+        q.put(None)
+        thread.join(timeout=30.0)
+    reg = getattr(threading, '_register_atexit', None)
+    if reg is not None:
+        try:
+            reg(_drain_and_join)
+            return
+        except RuntimeError:    # already shutting down: nothing to do
+            return
+    import atexit
+    atexit.register(_drain_and_join)
+
+
 class FleetIngest:
     """Batches the byte streams of many live connections through the
     device wire pipeline, one dispatch per event-loop tick.
@@ -562,8 +589,10 @@ class FleetIngest:
                     finally:
                         q.task_done()
 
-            threading.Thread(target=drain, daemon=True,
-                             name='ingest-warm').start()
+            t = threading.Thread(target=drain, daemon=True,
+                                 name='ingest-warm')
+            t.start()
+            _guard_warm_exit(t, q)
 
         def work():
             ex = self._try_compile(key)

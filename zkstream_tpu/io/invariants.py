@@ -207,6 +207,18 @@ class History:
         won ``epoch``.  Invariant 7 replays these."""
         return self._add('election', member=member, epoch=epoch)
 
+    def reconfig(self, version: int, phase: str, epoch: int,
+                 voters, old_voters=None, observers=()) -> dict:
+        """A committed membership-change record (store.py
+        ``propose_reconfig``/``commit_reconfig``): config ``version``
+        installed under leadership ``epoch``, ``phase`` 'joint'
+        (C_old+C_new both govern) or 'final'.  The invariant-7
+        extension (:func:`check_reconfig`) replays these."""
+        return self._add('reconfig', version=version, phase=phase,
+                         epoch=epoch, voters=tuple(voters),
+                         old_voters=tuple(old_voters or ()),
+                         observers=tuple(observers or ()))
+
     def session_event(self, event: str, session_id: int) -> dict:
         return self._add('session', event=event,
                          session_id=session_id)
@@ -595,6 +607,45 @@ def check_election(history: History) -> list[str]:
     return out
 
 
+def check_reconfig(history: History) -> list[str]:
+    """Invariant 7 extension (README "Dynamic membership"): config
+    versions strictly increase in history order, at most ONE
+    voter-set change (joint record) lands per leadership epoch, and
+    no joint window opens while another still stands.  The per-epoch
+    fence is what makes a reconfig record safe to recover mid-joint:
+    a deposed leader's half-finished change can never interleave
+    with its successor's in the same epoch."""
+    out: list[str] = []
+    prev_version: int | None = None
+    joint_by_epoch: dict[int, int] = {}
+    open_joint: int | None = None
+    for r in history.of_kind('reconfig'):
+        v = r['version']
+        if prev_version is not None and v <= prev_version:
+            out.append(
+                'config version not increasing: v%d recorded after '
+                'v%d' % (v, prev_version))
+        prev_version = v
+        if r['phase'] == 'joint':
+            if open_joint is not None:
+                out.append(
+                    'joint config v%d proposed while v%d still open '
+                    '(two overlapping membership changes)'
+                    % (v, open_joint))
+            open_joint = v
+            e = r['epoch']
+            if e in joint_by_epoch:
+                out.append(
+                    'two voter-set changes in epoch %d: v%d and v%d '
+                    '(at-most-one-change-per-epoch fence breached)'
+                    % (e, joint_by_epoch[e], v))
+            else:
+                joint_by_epoch[e] = v
+        else:
+            open_joint = None
+    return out
+
+
 def check_history(history: History, db) -> list[str]:
     """Run every invariant against the history and the leader's
     final database; returns the combined violation list."""
@@ -610,6 +661,7 @@ def check_history(history: History, db) -> list[str]:
     out.extend(check_sequential(history))
     out.extend(check_watch_once(history))
     out.extend(check_election(history))
+    out.extend(check_reconfig(history))
     out.extend(check_multi_atomic(history, db))
     # invariant 9: per-key WGL linearizability over the interval
     # records (vacuous on histories that carry none)
@@ -625,7 +677,8 @@ def check_history(history: History, db) -> list[str]:
 
 
 def format_history(history: 'History | list[dict]',
-                   kinds=('member', 'session', 'election'),
+                   kinds=('member', 'session', 'election',
+                          'reconfig'),
                    limit: int | None = None,
                    columns: bool = False) -> str:
     """Render the member-event (and session-edge) timeline for a
@@ -653,6 +706,14 @@ def format_history(history: 'History | list[dict]',
             lines.append('  t=%-4d member %-8s ELECTED leader '
                          '(epoch %d)'
                          % (r['t'], r['member'], r['epoch']))
+        elif r['kind'] == 'reconfig':
+            old = (' old=%s' % (','.join(map(str, r['old_voters'])),)
+                   if r['old_voters'] else '')
+            lines.append('  t=%-4d config v%-7d RECONFIG %s '
+                         'voters=%s%s (epoch %d)'
+                         % (r['t'], r['version'], r['phase'],
+                            ','.join(map(str, r['voters'])), old,
+                            r['epoch']))
         else:
             lines.append('  t=%-4d session %016x %s'
                          % (r['t'], r['session_id'], r['event']))

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import zlib
 
 from ..utils.events import EventEmitter
 from ..utils.fsm import note_transition
@@ -56,6 +57,60 @@ def read_distribution_default() -> bool:
     return os.environ.get('ZKSTREAM_READ_DISTRIBUTION') == '1'
 
 
+def read_subset_default() -> int | None:
+    """Process-wide read-plane subset cap: ``ZKSTREAM_READ_SUBSET=K``
+    makes each client dial at most K read sessions from the live
+    member list instead of one per backend (None/unset/0 = dial them
+    all, the legacy shape).  Large fleets want this: per-client
+    session count stays O(K) while membership grows."""
+    v = os.environ.get('ZKSTREAM_READ_SUBSET')
+    if not v:
+        return None
+    k = int(v)
+    return k if k > 0 else None
+
+
+class Resolver(EventEmitter):
+    """Elastic backend source (README "Dynamic membership"): the
+    live member list behind a client, replacing the static
+    ``servers[]`` snapshot taken at construction.
+
+    ``update(backends)`` adopts a new fleet — fed by whatever learns
+    of a membership change first: the chaos campaigns push the
+    ensemble's post-reconfig config directly, an operator can push a
+    list scraped from ``mntr``'s ``zk_config_members`` row — and
+    emits ``changed(backends)`` so subscribers (the ReadPlane)
+    rebalance their dialed subset.  The primary session is NOT torn
+    down on update: the pool keeps its current connection until it
+    dies, then redials against the updated list (``backends`` is read
+    per dial cycle), so a removed member drains rather than drops."""
+
+    def __init__(self, backends: list[Backend]):
+        super().__init__()
+        self._backends = list(backends)
+
+    @property
+    def backends(self) -> list[Backend]:
+        return list(self._backends)
+
+    def update(self, backends) -> bool:
+        """Adopt ``backends`` (Backend objects or (address, port)
+        pairs) as the live list.  Returns True — and notifies
+        subscribers — only when the membership actually changed."""
+        new = []
+        for b in backends:
+            if isinstance(b, Backend):
+                new.append(b)
+            else:
+                a, p = b
+                new.append(Backend(a, int(p)))
+        if [b.key for b in new] == [b.key for b in self._backends]:
+            return False
+        self._backends = new
+        self.emit('changed', list(new))
+        return True
+
+
 class ReadPlane:
     """Client-side read scale-out (README "Read plane"): one
     lightweight read client per backend, so ``get``/``exists``/
@@ -80,45 +135,126 @@ class ReadPlane:
     zxid-validated — an error reply carries no observable state — so
     they bounce to the primary too; only the primary's verdict is
     ever surfaced.  Every read therefore costs at most two RTTs and
-    usually one, on a member that is not the write path."""
+    usually one, on a member that is not the write path.
 
-    def __init__(self, client, backends: list[Backend]):
+    With ``subset=K`` the plane dials at most K read sessions, chosen
+    from the live list by rendezvous hashing on the client seed —
+    deterministic per client, spread across clients, and minimally
+    churned when the membership changes.  A :class:`Resolver` makes
+    the list live: on ``changed`` the plane retires subs whose
+    backend left its selection and dials the newcomers (README
+    "Dynamic membership")."""
+
+    def __init__(self, client, backends: list[Backend],
+                 subset: int | None = None,
+                 resolver: Resolver | None = None):
         self._client = client
-        self._backends = list(backends)
+        self._resolver = (resolver if resolver is not None
+                          else Resolver(backends))
+        self._backends = self._resolver.backends
+        self.subset = subset
         self.subs: list = []          # one lightweight Client each
         self._rr = 0
         self.started = False
+        #: Monotone dial counter: each sub's seed derives from its
+        #: dial ORDINAL, not its position in a mutable list, so the
+        #: rerun-key determinism of chaos campaigns survives
+        #: membership churn.
+        self._dialed = 0
+        #: Rendezvous-hash salt for subset selection (no seed: pick
+        #: one per plane so unseeded clients still spread).
+        self._salt = (client._seed if client._seed is not None
+                      else random.randrange(1 << 30))
         #: reads served by the plane / discarded-stale re-issues /
         #: sub-connection failures that fell back to the primary
         self.distributed = 0
         self.bounced = 0
         self.fallbacks = 0
+        #: config-change rebalances applied since start
+        self.rebalances = 0
+        self._resolver.on('changed', self._on_config_change)
+
+    def _select(self) -> list[Backend]:
+        """The ≤``subset`` backends this plane should be dialing.
+        Rendezvous hashing (highest crc32(salt|key) wins) keeps the
+        choice deterministic per (seed, member list) and moves at
+        most the displaced sessions when membership changes — a
+        joining member steals ~K/N of the fleet's read sessions
+        instead of triggering a full reshuffle."""
+        backs = self._backends
+        k = self.subset
+        if k is None or k >= len(backs):
+            return list(backs)
+        scored = sorted(
+            backs,
+            key=lambda b: zlib.crc32(
+                (b'%d|' % self._salt) + b.key.encode()))
+        return scored[:k]
+
+    def _dial(self, b: Backend):
+        from ..client import Client   # deferred: client.py imports us
+        c = self._client
+        # inherit the parent's seed (derived per dial ordinal) and
+        # retry policies: chaos rerun-key determinism reaches the
+        # read sessions' backoff jitter too
+        self._dialed += 1
+        seed = (None if c._seed is None
+                else c._seed * 1000003 + self._dialed)
+        sub = Client(address=b.address, port=b.port,
+                     session_timeout=c.session_timeout,
+                     shuffle_backends=False, max_spares=0,
+                     op_timeout=c.op_timeout, faults=c.faults,
+                     log=c.log, seed=seed,
+                     connect_policy=c.pool._connect_policy,
+                     default_policy=c._retry_policy,
+                     read_distribution=False)
+        sub.start()
+        self.subs.append(sub)
+        return sub
 
     def start(self) -> None:
-        """Dial one read client per backend (lazy sub-sessions: each
-        is a full handshake — the read capacity IS those sessions
-        landing on followers/observers)."""
+        """Dial one read client per selected backend (lazy
+        sub-sessions: each is a full handshake — the read capacity IS
+        those sessions landing on followers/observers)."""
         if self.started:
             return
         self.started = True
-        from ..client import Client   # deferred: client.py imports us
-        c = self._client
-        for i, b in enumerate(self._backends):
-            # inherit the parent's seed (derived per backend) and
-            # retry policies: chaos rerun-key determinism reaches the
-            # read sessions' backoff jitter too
-            seed = (None if c._seed is None
-                    else c._seed * 1000003 + i + 1)
-            sub = Client(address=b.address, port=b.port,
-                         session_timeout=c.session_timeout,
-                         shuffle_backends=False, max_spares=0,
-                         op_timeout=c.op_timeout, faults=c.faults,
-                         log=c.log, seed=seed,
-                         connect_policy=c.pool._connect_policy,
-                         default_policy=c._retry_policy,
-                         read_distribution=False)
-            sub.start()
-            self.subs.append(sub)
+        for b in self._select():
+            self._dial(b)
+
+    def _on_config_change(self, backends: list[Backend]) -> None:
+        """Resolver callback: re-run subset selection against the new
+        member list, retire subs whose backend left it, dial the
+        newcomers.  Retirement is a clean async close (the session's
+        CLOSE_SESSION drains in the background) so in-flight reads on
+        a leaving member finish or bounce — never hang."""
+        self._backends = list(backends)
+        if not self.started:
+            return
+        want = {b.key: b for b in self._select()}
+        have = {}
+        changed = False
+        for sub in list(self.subs):
+            key = sub.pool.backends[0].key
+            if key in want and key not in have:
+                have[key] = sub
+            else:
+                self.subs.remove(sub)
+                ambient_loop().create_task(self._retire(sub))
+                changed = True
+        for key, b in want.items():
+            if key not in have:
+                self._dial(b)
+                changed = True
+        if changed:
+            self.rebalances += 1
+
+    @staticmethod
+    async def _retire(sub) -> None:
+        try:
+            await asyncio.wait_for(sub.close(), 5)
+        except (asyncio.TimeoutError, TimeoutError):
+            sub.pool.stop()
 
     def pick(self, avoid_key: str | None = None):
         """The next connected read client, round-robin, preferring
@@ -145,6 +281,8 @@ class ReadPlane:
         return None
 
     async def close(self) -> None:
+        self._resolver.remove_listener('changed',
+                                       self._on_config_change)
         subs, self.subs = self.subs, []
         for sub in subs:
             try:
@@ -216,6 +354,26 @@ class ConnectionPool(EventEmitter):
 
     def current_backend(self) -> Backend | None:
         return self.conn.backend if self.conn is not None else None
+
+    def set_backends(self, backends: list[Backend]) -> None:
+        """Adopt a new live backend list (README "Dynamic
+        membership").  The current connection is left alone — a
+        removed member drains in place and its eventual death redials
+        against the updated list (the dial loop reads ``_backends``
+        each cycle) — but parked spares on departed backends are
+        destroyed so a failover cannot promote onto one."""
+        self._backends = list(backends)
+        keys = {b.key for b in self._backends}
+        if self.conn is not None:
+            self._conn_index = (
+                self._backend_index(self.conn.backend)
+                if self.conn.backend.key in keys else None)
+        drop = [s for s in self.spares if s.backend.key not in keys]
+        if drop:
+            self.spares = [s for s in self.spares if s not in drop]
+            for s in drop:
+                s.destroy()
+            self._wake_spares()
 
     # -- lifecycle --
 

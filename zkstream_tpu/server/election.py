@@ -197,6 +197,15 @@ class ElectionCoordinator(EventEmitter):
         #: enter a ballot, never win, and never count toward the
         #: election quorum denominator.
         self.voters = voters if voters is not None else len(servers)
+        #: Dynamic membership (README "Dynamic membership"): the
+        #: CURRENT voter set by member index — reconfig records
+        #: (server/store.py) repoint it via :meth:`set_config`.  While
+        #: ``old_voter_set`` stands (a joint window), an election
+        #: needs a reachable majority of BOTH sets, and the ballot is
+        #: open to their union; once the final record commits, a
+        #: removed member can neither stand nor be counted reachable.
+        self.voter_set: set[int] = set(range(self.voters))
+        self.old_voter_set: set[int] | None = None
         self.heartbeat_ms = (heartbeat_ms if heartbeat_ms is not None
                              else DEFAULT_HEARTBEAT_MS)
         self.leader_idx = 0
@@ -272,11 +281,34 @@ class ElectionCoordinator(EventEmitter):
 
     # -- the election itself --
 
+    def set_config(self, voter_set, old_voter_set=None) -> None:
+        """Adopt a reconfig record's voter set(s): ``voter_set`` is
+        C_new, ``old_voter_set`` C_old while the joint window stands
+        (both-majorities rule).  A member removed by the final record
+        leaves the ballot immediately."""
+        self.voter_set = set(voter_set)
+        self.old_voter_set = (set(old_voter_set)
+                              if old_voter_set is not None else None)
+
     def _candidates(self) -> list[int]:
-        # voters only: an observer holds the same history but must
-        # never stand (or be counted reachable) in an election
-        return [i for i in range(self.voters)
-                if self._alive(i) and i not in self.partitioned]
+        # the live ballot: current voters, plus C_old's during a
+        # joint window; an observer (or a removed member) holds the
+        # same history but must never stand (or be counted reachable)
+        live = self.voter_set | (self.old_voter_set or set())
+        return [i for i in sorted(live)
+                if i < len(self.servers) and self._alive(i)
+                and i not in self.partitioned]
+
+    def _quorum_reached(self, cands) -> bool:
+        """A reachable majority of EVERY active voter set: C_new
+        alone in stable state, C_old AND C_new during a joint
+        window — the election half of joint consensus."""
+        cs = set(cands)
+        for cfg in ((self.voter_set,) if self.old_voter_set is None
+                    else (self.voter_set, self.old_voter_set)):
+            if not cfg or len(cs & cfg) < quorum_of(len(cfg)):
+                return False
+        return True
 
     async def elect(self, reason: str) -> int | None:
         """Run one election among live, unpartitioned members.
@@ -289,7 +321,7 @@ class ElectionCoordinator(EventEmitter):
         t0 = time.perf_counter()
         try:
             cands = self._candidates()
-            if len(cands) < quorum_of(self.voters):
+            if not self._quorum_reached(cands):
                 return None
             self.emit('electing', reason)
             for i in cands:
@@ -299,7 +331,7 @@ class ElectionCoordinator(EventEmitter):
             # kill racing the vote lands before the tally
             await asyncio.sleep(0)
             cands = self._candidates()
-            if len(cands) < quorum_of(self.voters):
+            if not self._quorum_reached(cands):
                 for i in self._candidates():
                     self.servers[i].role = 'follower'
                 return None
@@ -618,7 +650,8 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                      election_port: int, peers,
                      sync: str = 'tick',
                      ready_cb=None, observer: bool = False,
-                     voters: int | None = None) -> None:
+                     voters: int | None = None,
+                     voter_ids=None, observer_ids=None) -> None:
     """One symmetric ensemble-member process: recover local state,
     run elections forever, serve clients on ``client_port`` whatever
     the current role.  ``peers`` is ``[(id, host, election_port)]``
@@ -659,6 +692,11 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
         'zxid_fn': (lambda: rec.zxid),
     }
     voting_total = voters if voters is not None else len(peers) + 1
+    if rec.config is not None and rec.config.get('voters'):
+        # a reconfig record on disk supersedes the spawn-time shape:
+        # this member votes (and counts quorums) at the membership it
+        # last durably learned
+        voting_total = len(rec.config['voters'])
     peer = ElectionPeer(member_id, peers, total=voting_total,
                         port=election_port, seed=member_id,
                         promise_dir=wal_dir, observer=observer)
@@ -711,6 +749,10 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
                 # database (fresh expiry clocks; a client that
                 # resumes inside the timeout keeps its ephemerals)
                 restore_sessions(db, src.session_snapshot())
+                # so does the membership config the mirror replicated
+                # (including an in-progress joint window)
+                if src.config is not None:
+                    db.install_config(src.config)
             elif led_db is not None:
                 # a deposed ex-leader re-winning (the successor era
                 # ended before this member ever re-followed): its own
@@ -727,6 +769,28 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             new_epoch = max(target_epoch, db.epoch + 1)
             db.bump_epoch(new_epoch)
             reap_orphan_ephemerals(db)
+            if db.voter_ids is None and voter_ids is not None:
+                # never-reconfigured ensemble: install the spawn
+                # shape as config version 0 so the rcfg admin
+                # channel (server/server.py) has a base to change
+                db.install_config({
+                    'version': 0, 'phase': 'final',
+                    'voters': tuple(voter_ids), 'old_voters': None,
+                    'observers': tuple(observer_ids or ())})
+            if db.old_voter_ids is not None:
+                # an in-progress reconfig survived (recovered from
+                # WAL control records, or inherited from the mirror):
+                # the new leader finishes it — the final record
+                # commits under the fresh epoch, closing the joint
+                # window instead of wedging quorum math on a fleet
+                # that may never reassemble C_old
+                db.commit_reconfig()
+                log.info('member %d completed recovered reconfig '
+                         '(config version %d)', member_id,
+                         db.config_version)
+            if db.voter_ids is not None:
+                voting_total = len(db.voter_ids)
+                peer.total = voting_total
             # quorum-commit: the VOTING membership is the voter set
             # (observer mirrors ack for the truncation floor but
             # never toward the majority), so a write acked through
@@ -753,6 +817,24 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             # member bounces with EPOCH_FENCED (same check the
             # forwarded path applies)
             server.fence = (lambda s=svc: s.deposed)
+
+            def _member_reconfig(phase, entry, q=svc.quorum,
+                                 p=peer) -> None:
+                # a reconfig committed while leading repoints the
+                # quorum denominator and this peer's election total.
+                # The OS tier's gate is count-based (follower tokens
+                # are anonymous uuids): during a joint window it
+                # holds the STRICTER of the two configs' majorities
+                # by count; the in-process tier carries the full
+                # named-set joint rule (server/replication.py).
+                if db.voter_ids is None:
+                    return
+                n = len(db.voter_ids)
+                if phase == 'joint' and db.old_voter_ids is not None:
+                    n = max(n, len(db.old_voter_ids))
+                q.total = n
+                p.total = len(db.voter_ids)
+            db.on_config_change = _member_reconfig
             server.elections += 1
             log.info('member %d leading at epoch %d (zxid %d)',
                      member_id, new_epoch, db.zxid)
@@ -831,6 +913,15 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             redial.reset()
             store = RemoteReplicaStore(remote, lag=0.0,
                                        recovered=recovered)
+            # a reconfig record arriving over replication repoints
+            # this follower's election total live (count-based at
+            # this tier; a joint window holds the stricter of the
+            # two configs' majorities by count)
+            store.on_config_applied = (
+                lambda cfg, p=peer: setattr(
+                    p, 'total',
+                    max(len(cfg['voters']),
+                        len(cfg.get('old_voters') or ()))))
             if not remote.resynced:
                 # snapshot bootstrap: the on-disk history is stale
                 # relative to the installed image — reset and
@@ -967,6 +1058,19 @@ async def _scrape_mntr(port: int, timeout: float = 2.0) -> dict:
     return out
 
 
+async def _rcfg(port: int, line: str, timeout: float = 8.0) -> str:
+    """One raw-TCP ``rcfg`` admin line against one member -> reply."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection('127.0.0.1', port), timeout)
+    try:
+        writer.write(('rcfg %s\n' % (line,)).encode())
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+    return data.decode('utf-8', 'replace')
+
+
 async def find_leader(members, min_epoch: int = 0,
                       timeout: float = PROC_LEADER_S):
     """Poll the live members' mntr rows until one reports
@@ -996,7 +1100,8 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                generations: int = 2,
                                workdir: str | None = None,
                                clients: int | None = None,
-                               observers: int = 0):
+                               observers: int = 0,
+                               reconfig: bool = False):
     """One seeded OS-process election schedule: spawn ``members``
     symmetric peer processes over per-member WAL dirs, drive a seeded
     workload THROUGH THE LEADER (quorum-commit makes its ack
@@ -1026,13 +1131,21 @@ async def run_process_schedule(seed: int, ops: int = 6,
     from ..client import Client
     from ..io.faults import ScheduleResult, record_settle_error
     from ..io.invariants import (AMBIGUOUS_CODES, History,
-                                 check_election)
+                                 check_election, check_reconfig)
     from ..protocol.errors import ZKError, ZKProtocolError
 
     rng = random.Random('proc/%d' % (seed,))
     #: observer churn draws come from their OWN stream: attaching
     #: observers must not perturb the schedule existing seeds pin
     orng = random.Random('proc-obs/%d' % (seed,))
+    #: reconfig victim draws likewise (``--reconfig`` joins the rerun
+    #: key; existing pinned seeds see zero draws from this stream)
+    prng = random.Random('proc-reconfig/%d' % (seed,))
+    if reconfig and observers == 0:
+        # the replace-voter swap needs a non-voting member to promote:
+        # --reconfig implies at least one observer (part of the flag's
+        # rerun-key semantics, like --observers itself)
+        observers = 1
     res = ScheduleResult(seed=seed, tier='process',
                          clients=clients if clients else 1)
     h = History()
@@ -1213,6 +1326,59 @@ async def run_process_schedule(seed: int, ops: int = 6,
         finally:
             await c.close()
 
+    #: the schedule's view of the LOGICAL membership (member ids):
+    #: starts at the spawn shape, moves with every applied reconfig.
+    #: Spawn roles stay fixed — this tier is count-based (see
+    #: run_member) — but quorum denominators and election totals
+    #: follow these sets through the replicated CONTROL records.
+    cfg_voters = sorted(range(members))
+    cfg_observers = sorted(range(members, total))
+
+    def _pick_swap(leader_id: int):
+        """One replace-voter shape: a non-leader voter demotes to
+        observer, an observer promotes into the voter set (sizes
+        preserved, so every later quorum stays satisfiable)."""
+        cands = [v for v in cfg_voters if v != leader_id]
+        v = cands[prng.randrange(len(cands))]
+        o = cfg_observers[prng.randrange(len(cfg_observers))]
+        new_voters = sorted([x for x in cfg_voters if x != v] + [o])
+        new_obs = sorted([x for x in cfg_observers if x != o] + [v])
+        return v, o, new_voters, new_obs
+
+    async def reconfig_round(leader_id: int, epoch: int) -> None:
+        """One fenced replace-voter reconfiguration through the rcfg
+        admin channel: ``apply`` lands the joint record, awaits its
+        quorum, commits, awaits the final record — the process tier's
+        analogue of the ensemble tier's forced reconfig step."""
+        nonlocal cfg_voters, cfg_observers
+        v, o, new_voters, new_obs = _pick_swap(leader_id)
+        line = 'apply %s %s' % (','.join(map(str, new_voters)),
+                                ','.join(map(str, new_obs)) or '-')
+        try:
+            reply = await asyncio.wait_for(
+                _rcfg(fleet[leader_id].client_port, line), 20)
+        except (OSError, asyncio.TimeoutError, TimeoutError) as e:
+            res.violations.append(
+                'rcfg apply (replace %d->%d) did not complete: %s'
+                % (v, o, e))
+            return
+        if reply.startswith('applied'):
+            version = int(reply.split('version=')[1].split()[0])
+            cfg_voters, cfg_observers = new_voters, new_obs
+            h.reconfig(version, 'final', epoch, voters=new_voters,
+                       observers=new_obs)
+            h.member_event('reconfig-replace-voter(%d->%d)'
+                           % (v, o), o)
+        elif reply.startswith('error'):
+            # a legal fence refusal (one voter change per epoch) is
+            # a recorded non-event, not a violation
+            h.member_event('reconfig-refused(%s)'
+                           % (reply.strip(),), v)
+        else:
+            res.violations.append(
+                'rcfg apply (replace %d->%d) unexpected reply %r'
+                % (v, o, reply))
+
     try:
         for m in fleet:
             m.spawn(fleet)
@@ -1263,10 +1429,46 @@ async def run_process_schedule(seed: int, ops: int = 6,
             await victim.wait_ready()
             h.member_event('restart', victim.member_id)
             await verify(leader_id, 'after election %d' % (round_no,))
+            if reconfig and (round_no < elections - 1
+                             or not generations):
+                # one voter replace per freshly elected era (the
+                # at-most-one-voter-change-per-epoch fence clears on
+                # every leader kill above).  The LAST era's voter-
+                # change budget is reserved for the mid-joint
+                # SIGKILL below — same epoch, same fence.
+                await reconfig_round(leader_id, epoch)
         await work(elections, leader_id)
 
         # -- full-ensemble SIGKILL -> election from recovered WALs --
         for gen in range(generations):
+            pending = None
+            if reconfig and gen == 0:
+                # land the JOINT record only, then SIGKILL the whole
+                # ensemble mid-window: recovery must finish the
+                # reconfig from WAL CONTROL records alone (the new
+                # leader's commit_reconfig on promotion) — or, if the
+                # record never reached a durable majority, roll back
+                # to the pre-propose config.  Either way the joint
+                # window must not survive recovery.
+                v, o, nv, no = _pick_swap(leader_id)
+                line = 'propose %s %s' % (','.join(map(str, nv)),
+                                          ','.join(map(str, no))
+                                          or '-')
+                try:
+                    reply = await asyncio.wait_for(
+                        _rcfg(fleet[leader_id].client_port, line), 20)
+                except (OSError, asyncio.TimeoutError,
+                        TimeoutError) as e:
+                    res.violations.append(
+                        'rcfg propose mid-joint failed: %s' % (e,))
+                    reply = ''
+                if reply.startswith('proposed'):
+                    h.member_event('sigkill-mid-joint(%d->%d)'
+                                   % (v, o), 'ensemble')
+                    pending = (nv, no)
+                elif reply.startswith('error'):
+                    h.member_event('reconfig-refused(%s)'
+                                   % (reply.strip(),), v)
             h.member_event('sigkill-all(gen %d)' % (gen,), 'ensemble')
             for m in fleet:
                 m.kill()
@@ -1283,6 +1485,48 @@ async def run_process_schedule(seed: int, ops: int = 6,
                     'full-ensemble recovery (%d -> %d)'
                     % (gen, prev, epoch))
             record_election(leader_id, epoch)
+            if reconfig:
+                # the joint window must be resolved (gen 0), and the
+                # resolved config must keep surviving every further
+                # generation of full-ensemble SIGKILL
+                try:
+                    status = await asyncio.wait_for(
+                        _rcfg(fleet[leader_id].client_port,
+                              'status'), 20)
+                except (OSError, asyncio.TimeoutError,
+                        TimeoutError) as e:
+                    status = ''
+                    res.violations.append(
+                        'generation %d: rcfg status unreadable '
+                        'after recovery: %s' % (gen, e))
+                if status and 'phase=final' not in status:
+                    res.violations.append(
+                        'generation %d: joint config survived '
+                        'full-ensemble recovery (%r)'
+                        % (gen, status.strip()))
+                elif status and pending is not None:
+                    version = int(
+                        status.split('version=')[1].split()[0])
+                    voters_csv = status.split('voters=')[1].split()[0]
+                    got = sorted(int(x) for x in voters_csv.split(',')
+                                 if x and x != '-')
+                    if got == pending[0]:
+                        cfg_voters, cfg_observers = pending
+                        h.reconfig(version, 'final', epoch,
+                                   voters=cfg_voters,
+                                   observers=cfg_observers)
+                        h.member_event(
+                            'reconfig-recovered(v%d)' % (version,),
+                            'ensemble')
+                    elif got == cfg_voters:
+                        h.member_event('reconfig-rolled-back',
+                                       'ensemble')
+                    else:
+                        res.violations.append(
+                            'generation %d: recovered voter set %s '
+                            'matches neither the proposed %s nor '
+                            'the prior %s config'
+                            % (gen, got, pending[0], cfg_voters))
             await verify(leader_id,
                          'generation %d (recovered WALs)' % (gen,))
             # one more acked write per generation: the recovered
@@ -1334,6 +1578,11 @@ async def run_process_schedule(seed: int, ops: int = 6,
             from ..analysis.linearize import check_session_reads
             res.violations.extend(check_session_reads(h))
         res.violations.extend(check_election(h))
+        res.violations.extend(check_reconfig(h))
+        if reconfig and not h.of_kind('reconfig'):
+            res.violations.append(
+                'reconfig schedule completed no membership change '
+                '(every rcfg apply refused or rolled back)')
         if observers:
             # observers must never have stood: every recorded
             # election winner is a voter, and every live observer
@@ -1384,20 +1633,24 @@ async def run_process_campaign(base_seed: int, schedules: int,
                                ops: int = 6, progress=None,
                                elections: int | None = None,
                                clients: int | None = None,
-                               observers: int | None = None):
+                               observers: int | None = None,
+                               reconfig: bool = False):
     """Consecutive seeded process-tier schedules from ``base_seed``.
     ``elections`` overrides the per-schedule forced leader-kill count,
     ``clients`` > 1 makes every workload phase concurrent with
-    the linearizability pass at the end, and ``observers`` attaches N
-    non-voting read-serving members with their own churn stream (all
-    part of the rerun key, like the ensemble tier's flags)."""
+    the linearizability pass at the end, ``observers`` attaches N
+    non-voting read-serving members with their own churn stream, and
+    ``reconfig`` drives a fenced voter replace through the rcfg admin
+    channel per elected era plus one full-ensemble SIGKILL mid-joint
+    (all part of the rerun key, like the ensemble tier's flags)."""
     out = []
     for i in range(schedules):
         r = await run_process_schedule(
             base_seed + i, ops=ops,
             elections=elections if elections is not None else 2,
             clients=clients,
-            observers=observers if observers is not None else 0)
+            observers=observers if observers is not None else 0,
+            reconfig=reconfig)
         out.append(r)
         if progress is not None:
             progress(r)

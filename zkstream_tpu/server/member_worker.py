@@ -55,20 +55,25 @@ def main() -> int:
     rest = sys.argv[5:]
     observer = '--observer' in rest
     peers = []
-    peer_voters = 0
+    voter_ids = [] if observer else [member_id]
+    observer_ids = [member_id] if observer else []
     for spec in rest:
         if spec == '--observer':
             continue
         parts = spec.split(':')
         pid, host, port = parts[0], parts[1], parts[2]
         if len(parts) < 4 or parts[3] != 'observer':
-            peer_voters += 1
+            voter_ids.append(int(pid))
+        else:
+            observer_ids.append(int(pid))
         peers.append((int(pid), host, int(port)))
-    voters = peer_voters + (0 if observer else 1)
+    voters = len(voter_ids)
     sync = os.environ.get('ZKSTREAM_MEMBER_SYNC', 'tick')
     asyncio.run(run_member(member_id, wal_dir, client_port,
                            election_port, peers, sync=sync,
-                           observer=observer, voters=voters))
+                           observer=observer, voters=voters,
+                           voter_ids=sorted(voter_ids),
+                           observer_ids=sorted(observer_ids)))
     return 0
 
 

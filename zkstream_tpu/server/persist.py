@@ -139,10 +139,23 @@ def crc32c(data: bytes, crc: int = 0) -> int:
 #: none, and recovery filters them by log index, not zxid.  Tag 7
 #: ('multi') is one all-or-nothing transaction: every sub-entry in
 #: ONE CRC-framed record, so a torn multi replays atomically or not
-#: at all.
+#: at all.  Tag 8 ('reconfig') is the membership CONTROL record
+#: (server/store.py ``propose_reconfig``/``commit_reconfig``): a
+#: config change rides the WAL and the replication log — phase
+#: 'joint' installs C_old+C_new (quorum-commit and elections need
+#: majorities of BOTH voter sets), phase 'final' commits C_new alone
+#: — it consumes a zxid (the joint window is bounded by sequenced,
+#: committed records), and recovery filters it by LOG INDEX like the
+#: session records, so an in-progress reconfig survives a
+#: full-ensemble SIGKILL and the promoted successor can finish it.
 _TAGS = {'create': 1, 'delete': 2, 'set_data': 3, 'epoch': 4,
-         'session': 5, 'session_close': 6, 'multi': 7}
+         'session': 5, 'session_close': 6, 'multi': 7, 'reconfig': 8}
 _OPS = {v: k for k, v in _TAGS.items()}
+
+#: ('reconfig', version, phase, old_voters, new_voters, observers,
+#: zxid) phase byte values.
+_RECONFIG_PHASES = {'joint': 0, 'final': 1}
+_RECONFIG_NAMES = {v: k for k, v in _RECONFIG_PHASES.items()}
 
 #: ('session_close', sid, zxid, reason) reason byte values.
 _CLOSE_REASONS = {'close': 0, 'expire': 1}
@@ -190,6 +203,8 @@ def entry_zxid(entry: tuple) -> int:
         return entry[2]
     if op == 'session':
         return entry[4]
+    if op == 'reconfig':
+        return entry[6]
     if op == 'multi':
         return entry_zxid(entry[1][-1])
     raise ValueError('unknown log entry %r' % (op,))
@@ -218,6 +233,17 @@ def _spec_encode_entry(entry: tuple) -> bytes:
         w.write_long(sid)
         w.write_long(zxid)
         w.write_byte(_CLOSE_REASONS[reason])
+        return w.to_bytes()
+    if op == 'reconfig':
+        _, version, phase, old_voters, new_voters, observers, \
+            zxid = entry
+        w.write_long(version)
+        w.write_byte(_RECONFIG_PHASES[phase])
+        for members in (old_voters, new_voters, observers):
+            w.write_int(len(members))
+            for m in members:
+                w.write_int(m)
+        w.write_long(zxid)
         return w.to_bytes()
     if op == 'multi':
         subs = entry[1]
@@ -279,6 +305,16 @@ def encode_entry(entry: tuple) -> bytes:
         _, sid, zxid, reason = entry
         return (b'\x06' + _Q2.pack(sid, zxid)
                 + bytes((_CLOSE_REASONS[reason],)))
+    if op == 'reconfig':
+        _, version, phase, old_voters, new_voters, observers, \
+            zxid = entry
+        parts = [b'\x08', struct.pack('>q', version),
+                 bytes((_RECONFIG_PHASES[phase],))]
+        for members in (old_voters, new_voters, observers):
+            parts.append(_I.pack(len(members)))
+            parts.extend(_I.pack(m) for m in members)
+        parts.append(struct.pack('>q', zxid))
+        return b''.join(parts)
     if op == 'multi':
         subs = entry[1]
         parts = [b'\x07', _I.pack(len(subs))]
@@ -346,6 +382,20 @@ def decode_entry(body: bytes) -> tuple:
         if reason is None:
             raise ValueError('unknown session-close reason')
         return ('session_close', sid, zxid, reason)
+    if op == 'reconfig':
+        version = r.read_long()
+        phase = _RECONFIG_NAMES.get(r.read_byte())
+        if phase is None:
+            raise ValueError('unknown reconfig phase')
+        sets = []
+        for _ in range(3):
+            n = r.read_int()
+            # bounded by what can physically fit (4 bytes per member)
+            if not 0 <= n <= len(body) // 4:
+                raise ValueError('insane member count %d' % (n,))
+            sets.append(tuple(r.read_int() for _ in range(n)))
+        return ('reconfig', version, phase, sets[0], sets[1],
+                sets[2], r.read_long())
     if op == 'multi':
         n = r.read_int()
         # bounded by what can physically fit (a sub-record is at least
@@ -408,6 +458,9 @@ class SnapshotInfo:
     #: live sessions at capture, {sid: (passwd, timeout)} (format 3
     #: payload; empty for older images)
     sessions: dict = dataclasses.field(default_factory=dict)
+    #: membership config at capture (format 3 payload 'config' key;
+    #: None for older images or never-reconfigured ensembles)
+    config: dict | None = None
 
 
 @dataclasses.dataclass
@@ -489,18 +542,20 @@ def _read_snapshot(path: str, load_nodes: bool = True) -> SnapshotInfo:
         payload = buf[body_off:]
         if zlib.crc32(payload) & 0xFFFFFFFF != crc:
             raise ValueError('snapshot payload fails CRC')
-        nodes, sessions = None, {}
+        nodes, sessions, config = None, {}, None
         if load_nodes:
             image = pickle.loads(payload)
             if dict_payload:
                 nodes = image['nodes']
                 sessions = image.get('sessions', {})
+                config = image.get('config')
             else:
                 nodes = image
             if '/' not in nodes:
                 raise ValueError('snapshot image has no root')
         return SnapshotInfo(path, index, zxid, True, nodes,
-                            epoch=epoch, sessions=sessions)
+                            epoch=epoch, sessions=sessions,
+                            config=config)
     except Exception as e:
         # parse the stamp out of the filename so the CLI can still
         # list the corrupt file next to its intended position
@@ -561,6 +616,14 @@ class Recovery:
     #: expiry clock so ephemerals survive a restart inside the
     #: session timeout
     sessions: dict = dataclasses.field(default_factory=dict)
+    #: newest membership config on disk (snapshot 'config' key plus
+    #: reconfig control records replayed by log index) — a dict
+    #: ``{'version', 'phase', 'voters', 'old_voters', 'observers'}``,
+    #: or None when this ensemble was never reconfigured.  A
+    #: recovered ``phase == 'joint'`` is an IN-PROGRESS reconfig: the
+    #: member promoted over this WAL must finish it (commit the final
+    #: record) before the joint window can close.
+    config: dict | None = None
 
 
 def recover_state(path: str, trace=None) -> Recovery:
@@ -582,6 +645,8 @@ def recover_state(path: str, trace=None) -> Recovery:
     base_index = snap.index if snap is not None else 0
     epoch = snap.epoch if snap is not None else 0
     sessions = dict(snap.sessions) if snap is not None else {}
+    config = (dict(snap.config)
+              if snap is not None and snap.config else None)
     replayed = 0
     torn = False
     last_index = base_index
@@ -604,6 +669,20 @@ def recover_state(path: str, trace=None) -> Recovery:
                 # not apply — a bump consumes no zxid), never applied
                 # to the tree
                 epoch = max(epoch, entry[1])
+                last_index = max(last_index, idx + 1)
+                continue
+            if entry[0] == 'reconfig':
+                # membership control record: filtered by LOG INDEX
+                # like the session records (the snapshot's 'config'
+                # key covers everything before its stamp)
+                if idx >= base_index:
+                    _, ver, phase, old_v, new_v, obs, _z = entry
+                    config = {'version': ver, 'phase': phase,
+                              'voters': tuple(new_v),
+                              'old_voters': (tuple(old_v)
+                                             if phase == 'joint'
+                                             else None),
+                              'observers': tuple(obs)}
                 last_index = max(last_index, idx + 1)
                 continue
             if entry[0] in ('session', 'session_close'):
@@ -641,7 +720,7 @@ def recover_state(path: str, trace=None) -> Recovery:
                    snapshot_index=snap.index if snap else -1,
                    snapshot_zxid=snap.zxid if snap else 0,
                    replayed=replayed, torn=torn, detail=detail,
-                   epoch=epoch, sessions=sessions)
+                   epoch=epoch, sessions=sessions, config=config)
     if trace is not None:
         trace.note('WAL_RECOVER', path=path, zxid=rec.zxid,
                    kind='recovery',
@@ -1206,8 +1285,12 @@ class WriteAheadLog:
         # the session timeout keeps sessions — and their ephemerals
         snap_sessions = getattr(tree, 'session_snapshot',
                                 lambda: {})()
-        payload = pickle.dumps({'nodes': tree.nodes,
-                                'sessions': snap_sessions},
+        image = {'nodes': tree.nodes, 'sessions': snap_sessions}
+        snap_config = getattr(tree, 'config_snapshot',
+                              lambda: None)()
+        if snap_config is not None:
+            image['config'] = snap_config
+        payload = pickle.dumps(image,
                                protocol=pickle.HIGHEST_PROTOCOL)
         final = os.path.join(self.dir, 'snap.%016d' % (index,))
         tmp = final + '.tmp'
@@ -1462,6 +1545,8 @@ def open_wal_database(path: str, *, sync: str = 'tick',
     db.zxid = rec.zxid
     db.epoch = rec.epoch
     db.log_start_zxid = rec.zxid
+    if rec.config is not None:
+        db.install_config(rec.config)
     wal = WriteAheadLog(path, sync=sync, segment_bytes=segment_bytes,
                         segment_age_s=segment_age_s,
                         collector=collector, faults=faults)

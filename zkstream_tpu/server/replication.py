@@ -194,6 +194,17 @@ class QuorumGate:
         #: voter key -> newest acked zxid (follower token / member id;
         #: the leader's own vote is ``db.zxid``, never stored here)
         self.acked: dict = {}
+        #: Dynamic membership (server/store.py reconfig records).
+        #: ``voters`` None = legacy count-based majority over
+        #: ``total`` (bit-identical to pre-reconfig behavior).  When
+        #: set, the majority is computed over the NAMED voter keys —
+        #: and while ``old_voters`` stands (a joint window), over
+        #: BOTH sets, taking the lower floor: no txn is quorum-held
+        #: until a majority of C_old AND a majority of C_new hold it.
+        #: ``leader_key`` names the member whose vote is ``db.zxid``.
+        self.voters: set | None = None
+        self.old_voters: set | None = None
+        self.leader_key = None
         self.stale_acks = 0
         self.degraded_releases = 0
         #: newest zxid a majority is known to hold (cached; advanced
@@ -234,10 +245,19 @@ class QuorumGate:
     def note_ack(self, voter, zxid: int,
                  epoch: int | None = None) -> None:
         """One follower's piggybacked applied-zxid ack.  Epoch-fenced:
-        a stale era's ack never counts toward the current quorum."""
+        a stale era's ack never counts toward the current quorum.
+        Config-fenced: once a named voter set stands, an ack from a
+        member outside it (a removed voter — the reconfig fence) is
+        dropped and counted exactly like a stale epoch's."""
         if not self.enabled:
             return
         if epoch is not None and epoch < getattr(self.db, 'epoch', 0):
+            self.stale_acks += 1
+            return
+        if self.voters is not None and voter != self.leader_key \
+                and voter not in self.voters \
+                and (self.old_voters is None
+                     or voter not in self.old_voters):
             self.stale_acks += 1
             return
         if zxid <= self.acked.get(voter, 0):
@@ -250,11 +270,57 @@ class QuorumGate:
         (it can rejoin by acking again)."""
         self.acked.pop(voter, None)
 
+    def set_config(self, voters, old_voters=None,
+                   leader_key=None) -> None:
+        """Install the named voter set(s) from a reconfig record
+        (server/store.py): ``voters`` is C_new's ack keys,
+        ``old_voters`` C_old's while a joint window stands.  A removed
+        member's standing vote is forgotten immediately — it can
+        neither hold up nor satisfy the new majority — and its later
+        acks are fenced (``note_ack``)."""
+        self.voters = set(voters) if voters is not None else None
+        self.old_voters = (set(old_voters)
+                           if old_voters is not None else None)
+        if leader_key is not None:
+            self.leader_key = leader_key
+        if self.voters is not None:
+            live = self.voters | (self.old_voters or set())
+            for v in [v for v in self.acked if v not in live]:
+                del self.acked[v]
+        self._advance()
+
+    def _majority_floor(self, keys, extra=None) -> int:
+        """Majority floor over ONE named voter set: each member votes
+        its acked zxid (0 when it never acked), the leader its own
+        ``db.zxid``; ``extra = (key, zxid)`` counts one member's vote
+        virtually (the forwarded-write grant)."""
+        vals = []
+        for k in keys:
+            if k == self.leader_key:
+                vals.append(self.db.zxid)
+            elif extra is not None and k == extra[0]:
+                vals.append(max(extra[1], self.acked.get(k, 0)))
+            else:
+                vals.append(self.acked.get(k, 0))
+        if not vals:
+            return 0
+        vals.sort(reverse=True)
+        return vals[quorum_of(len(vals)) - 1]
+
     def quorum_zxid(self) -> int:
         """The newest zxid a majority of the membership holds (the
-        leader's own ``db.zxid`` is one vote)."""
+        leader's own ``db.zxid`` is one vote).  With a named voter
+        set installed the majority is per-set; during a joint window
+        it is the LOWER of the two sets' floors — majorities of both
+        C_old and C_new, the joint-consensus commit rule."""
         if not self.enabled:
             return self.db.zxid
+        if self.voters is not None:
+            floor = self._majority_floor(self.voters)
+            if self.old_voters is not None:
+                floor = min(floor,
+                            self._majority_floor(self.old_voters))
+            return floor
         pool = sorted([self.db.zxid] + list(self.acked.values()),
                       reverse=True)
         need = quorum_of(self.total)
@@ -267,7 +333,17 @@ class QuorumGate:
         response's own piggyback delivers the txn into its mirror
         before the client can see the ack, so its vote is guaranteed
         by construction, not awaited (awaiting it would deadlock a
-        two-member ensemble into the degrade timeout per write)."""
+        two-member ensemble into the degrade timeout per write).
+        Under a named config the grant only counts when the granter
+        is (still) a member of the set being tallied — a removed
+        voter's virtual vote is fenced like its real ones."""
+        if self.voters is not None:
+            extra = (grant, target) if grant is not None else None
+            floor = self._majority_floor(self.voters, extra)
+            if self.old_voters is not None:
+                floor = min(floor, self._majority_floor(
+                    self.old_voters, extra))
+            return floor
         pool = [self.db.zxid]
         if grant is not None:
             pool.append(target)
@@ -660,7 +736,8 @@ class ReplicationService:
                         # client (store.py session_snapshot)
                         self._push(h, ('snapshot', self.db.snapshot(),
                                        pos, self.epoch,
-                                       self.db.session_snapshot()))
+                                       self.db.session_snapshot(),
+                                       self.db.config_snapshot()))
                         log.info('follower %s joined late: snapshot '
                                  'at log index %d (zxid %d)', token,
                                  pos, self.db.zxid)
@@ -669,8 +746,12 @@ class ReplicationService:
                 h.writer = writer
             # the follower's connect() blocks until this lands: a
             # commit racing the hello would otherwise slip between
-            # "connected" and "attached" and never be logged
-            self._push(h, ('attached', self.epoch))
+            # "connected" and "attached" and never be logged.  The
+            # membership config rides along: the zero-history attach
+            # path ships no snapshot, and a follower must still
+            # learn the ensemble shape it joined
+            self._push(h, ('attached', self.epoch,
+                           self.db.config_snapshot()))
             # ship anything committed before this follower connected
             self._push_commits()
             try:
@@ -861,6 +942,12 @@ class RemoteLeader(EventEmitter):
         self.log: list = []
         self.log_base = 0
         self.sessions: dict[int, ZKServerSession] = {}
+        #: replicated membership config (store.py config_snapshot
+        #: form): seeded by the bootstrap image, then maintained by
+        #: the reconfig records the mirror replays — a promoted
+        #: ex-follower inherits the config, including an in-progress
+        #: joint window it must finish (server/election.py run_member)
+        self.config: dict | None = None
         #: optional mirror write-ahead log: every entry that lands in
         #: the mirror is appended (durability for the follower's own
         #: restart; the worker wires this, tests/process_member_worker)
@@ -1019,6 +1106,8 @@ class RemoteLeader(EventEmitter):
                         self._snapshot = (msg[1], msg[2])
                         self.log_base = msg[2]
                     self.seed_sessions(msg[4] if len(msg) > 4 else {})
+                    if len(msg) > 5 and msg[5] is not None:
+                        self.config = dict(msg[5])
                 elif msg[0] == 'resync':
                     # the leader accepted have_zxid as the catch-up
                     # base: no image — the recovered tree stands and
@@ -1030,6 +1119,12 @@ class RemoteLeader(EventEmitter):
                         self.log_base = msg[1]
                 elif msg[0] == 'attached':
                     self._adopt_epoch(msg[1] if len(msg) > 1 else None)
+                    if len(msg) > 2 and msg[2] is not None \
+                            and self.config is None:
+                        # don't regress a config a later reconfig
+                        # record already advanced past this
+                        # handshake's stamp
+                        self.config = dict(msg[2])
                     if not self._attached.done():
                         self._attached.set_result(True)
         except asyncio.CancelledError:
@@ -1251,6 +1346,12 @@ class RemoteReplicaStore(ReplicaStore):
       the write, and a second blocking round-trip per write would
       stall the member's whole event loop."""
 
+    #: Optional hook fired with each reconfig record's config dict as
+    #: it applies — run_member repoints this follower's election
+    #: total from it, so a later ballot counts quorums against the
+    #: membership the leader last committed, not the spawn shape.
+    on_config_applied = None
+
     def _apply_session(self, entry: tuple) -> None:
         """Session control records replicate the leader's session
         table into THIS follower's mirror handle — what keeps every
@@ -1270,6 +1371,22 @@ class RemoteReplicaStore(ReplicaStore):
                     sess.expired = True
                 else:
                     sess.closed = True
+
+    def _apply_reconfig(self, entry: tuple) -> None:
+        """Reconfig control records replicate the leader's membership
+        config into THIS follower's mirror handle — a promoted member
+        inherits it, joint window included (the run_member lead path
+        finishes an in-progress reconfig it recovers this way)."""
+        _, ver, phase, old_v, new_v, obs, _zxid = entry
+        cfg = {
+            'version': ver, 'phase': phase, 'voters': tuple(new_v),
+            'old_voters': (tuple(old_v) if phase == 'joint'
+                           else None),
+            'observers': tuple(obs)}
+        self.leader.config = cfg
+        hook = self.on_config_applied
+        if hook is not None:
+            hook(cfg)
 
     def __init__(self, leader: RemoteLeader, lag: float | None = 0.0,
                  recovered: dict | None = None):

@@ -46,6 +46,39 @@ log = logging.getLogger('zkstream_tpu.server')
 #: scraped from OS-process members.
 ADMIN_WORDS = frozenset((b'ruok', b'mntr', b'stat', b'srvr', b'trce'))
 
+#: The dynamic-membership admin channel (README "Dynamic membership"):
+#: ``rcfg <action> [args]\n`` — four-letter-word framing (raw bytes as
+#: the connection's first payload) but argument-bearing, so the word
+#: buffers through its newline before dispatch.  Leader-only; replies
+#: one text line and closes, mntr-style.
+RECONFIG_WORD = b'rcfg'
+
+METRIC_RECONFIG = 'zookeeper_reconfig_ms'
+RECONFIG_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                    100.0, 250.0, 1000.0)
+
+
+def _csv(members) -> str:
+    """Member-id list as the admin/mntr text form ('-' when empty)."""
+    return ','.join(str(m) for m in members) or '-'
+
+
+def _parse_members(s: str) -> tuple:
+    """Inverse of :func:`_csv` for ``rcfg`` argument lists."""
+    if s == '-':
+        return ()
+    return tuple(int(x) for x in s.split(',') if x != '')
+
+
+def _config_desc(voters, old_voters, observers, phase) -> str:
+    """The one-line member inventory ``zk_config_members`` carries."""
+    desc = 'voters=%s' % (_csv(voters),)
+    if old_voters:
+        desc += ' old_voters=%s' % (_csv(old_voters),)
+    if observers:
+        desc += ' observers=%s' % (_csv(observers),)
+    return desc + ' phase=%s' % (phase,)
+
 #: Member span-ring capacity: deep enough to hold a campaign's recent
 #: window (decode + per-txn chain + fan-out), fixed memory.
 MEMBER_RING_CAPACITY = 512
@@ -493,6 +526,20 @@ class ServerConnection:
                 return True
             self._admin_checked = True
             word = self._admin_buf[:4]
+            if word == RECONFIG_WORD:
+                # argument-bearing admin word: keep buffering until
+                # the line's newline arrives (re-arming the check so
+                # the next chunk lands back here)
+                if b'\n' not in self._admin_buf:
+                    self._admin_checked = False
+                    return True
+                line = self._admin_buf.split(b'\n', 1)[0]
+                self._handle_reconfig(
+                    line[4:].decode('utf-8', 'replace').strip())
+                # keep the connection open: unlike the synchronous
+                # words, the reply may await a quorum — the handler
+                # task writes it and closes
+                return True
             if word in ADMIN_WORDS:
                 self._handle_admin(word.decode('ascii'))
                 return False
@@ -562,6 +609,25 @@ class ServerConnection:
         except (ConnectionError, RuntimeError):
             pass
         self.close()
+
+    def _handle_reconfig(self, args: str) -> None:
+        """Serve one ``rcfg`` admin line.  Unlike the synchronous
+        four-letter words, ``apply`` awaits the joint-quorum commit —
+        so the handler runs as a task; reply text, then close."""
+        async def _run() -> None:
+            try:
+                text = await self.server.reconfig_admin(args)
+            except Exception as e:
+                text = 'error %s\n' % (e,)
+            if self.closed:
+                return
+            try:
+                self.writer.write(text.encode('utf-8'))
+            except (ConnectionError, RuntimeError):
+                pass
+            self.close()
+        from ..utils.aio import ambient_loop
+        self._rcfg_task = ambient_loop().create_task(_run())
 
     def close(self) -> None:
         if self.closed:
@@ -1010,6 +1076,11 @@ class ZKServer:
         #: env-gated ungated validator the checker must catch.
         self.read_gate = (ReadGate(self, collector=collector)
                           if read_gate_enabled() else None)
+        #: ``zookeeper_reconfig_ms`` histogram (lazy: registered on
+        #: the first membership change this member drives, so the
+        #: steady-state metric inventory is unchanged when dynamic
+        #: membership is never exercised).
+        self._rcfg_hist = None
 
     @property
     def ack_barrier(self):
@@ -1238,6 +1309,98 @@ class ZKServer:
             self.role = ('leader' if self.store is self.db
                          else 'follower')
 
+    # -- dynamic membership (README "Dynamic membership") --
+
+    def _installed_config(self) -> dict | None:
+        """The membership config this member can see: the database's
+        own (leader / in-process members sharing it), else the one
+        mirrored over replication (an OS-process follower's
+        RemoteLeader)."""
+        db = self.db
+        if getattr(db, 'voter_ids', None) is not None:
+            return db.config_snapshot()
+        return getattr(getattr(self.store, 'leader', None),
+                       'config', None)
+
+    def reconfig_status(self) -> str:
+        """One ``rcfg status`` reply line — answerable by any member,
+        like the four-letter words."""
+        cfg = self._installed_config()
+        if cfg is None:
+            return 'version=0 phase=static voters=- observers=-\n'
+        return 'version=%d phase=%s voters=%s observers=%s\n' % (
+            cfg['version'], cfg.get('phase') or 'final',
+            _csv(cfg['voters']), _csv(cfg.get('observers') or ()))
+
+    def _observe_reconfig(self, t0: float) -> None:
+        if self.collector is not None and self._rcfg_hist is None:
+            self._rcfg_hist = self.collector.histogram(
+                METRIC_RECONFIG,
+                'Membership reconfiguration latency (propose through '
+                'commit), ms', buckets=RECONFIG_BUCKETS)
+        if self._rcfg_hist is not None:
+            self._rcfg_hist.observe(
+                (time.perf_counter() - t0) * 1000.0)
+
+    async def reconfig_admin(self, args: str) -> str:
+        """Serve one ``rcfg`` admin line against this member.
+
+        Actions: ``status`` (any member) · ``propose <voters-csv>
+        [<observers-csv>]`` (leader-only: land the reconfig record —
+        for a voter change that is the JOINT record, and this call
+        deliberately stops there, which is what lets a chaos schedule
+        SIGKILL the ensemble mid-joint) · ``commit`` (leader-only:
+        finish an open joint window) · ``apply <voters-csv>
+        [<observers-csv>]`` (leader-only: propose, await the joint
+        record's quorum, commit, await the final record's quorum).
+        Observer lists default to the current observers minus any
+        member promoted into the new voter set."""
+        parts = args.split()
+        action = parts[0] if parts else 'status'
+        if action == 'status':
+            return self.reconfig_status()
+        db = self.db
+        if self.role != 'leader' \
+                or not hasattr(db, 'propose_reconfig') \
+                or (self.fence is not None and self.fence()):
+            # a RemoteLeader handle has no propose_reconfig either:
+            # followers answer status only, real-ZK style
+            return 'error not leader\n'
+        t0 = time.perf_counter()
+        try:
+            if action == 'commit':
+                entry = db.commit_reconfig()
+                self._observe_reconfig(t0)
+                return 'committed version=%d voters=%s\n' % (
+                    entry[1], _csv(entry[4]))
+            if action not in ('propose', 'apply'):
+                return 'error unknown action %r\n' % (action,)
+            if len(parts) < 2:
+                return 'error %s needs a voter list\n' % (action,)
+            voters = _parse_members(parts[1])
+            observers = (_parse_members(parts[2]) if len(parts) > 2
+                         else tuple(i for i in db.observer_ids
+                                    if i not in voters))
+            entry = db.propose_reconfig(voters, observers)
+        except ValueError as e:
+            return 'error %s\n' % (e,)
+        if action == 'propose' or entry[2] == 'final':
+            self._observe_reconfig(t0)
+            return '%s version=%d phase=%s zxid=0x%x\n' % (
+                'proposed' if action == 'propose' else 'applied',
+                entry[1], entry[2], entry[6])
+        # apply, joint phase: both configs must majority-hold the
+        # joint record before the final record may land
+        q = self.quorum
+        if q is not None and q.enabled:
+            await q.wait(entry[6])
+        final = db.commit_reconfig()
+        if q is not None and q.enabled:
+            await q.wait(final[6])
+        self._observe_reconfig(t0)
+        return 'applied version=%d voters=%s\n' % (
+            final[1], _csv(final[4]))
+
     def monitor_stats(self) -> list[tuple[str, object]]:
         """The ``mntr`` key/value inventory (ordered), real-ZK key
         names where an equivalent exists."""
@@ -1262,6 +1425,19 @@ class ZKServer:
             ('zk_quorum_zxid', '0x%x' % (q.quorum_zxid_floor,)),
             ('zk_quorum_degraded', q.degraded_releases),
             ('zk_quorum_stale_acks', q.stale_acks),
+        ]
+        # dynamic-membership rows (README "Dynamic membership"): the
+        # installed config's version, member inventory and the count
+        # of completed reconfigurations
+        cfg = self._installed_config()
+        config_rows = [] if cfg is None else [
+            ('zk_config_version', cfg['version']),
+            ('zk_config_members', _config_desc(
+                cfg['voters'], cfg.get('old_voters'),
+                cfg.get('observers') or (),
+                cfg.get('phase') or 'final')),
+            ('zk_reconfig_total',
+             getattr(self.db, 'reconfig_total', 0)),
         ]
         # zxid read-gate rows (README "Read plane"): reads parked
         # until this member caught up, and parked reads bounced to a
@@ -1326,7 +1502,7 @@ class ZKServer:
              'asyncio' if self.ingress is None
              else self.ingress.backend),
         ] + self._ingress_census_rows() + multi_rows + gate_rows \
-            + quorum_rows + tick_rows + wal_rows
+            + quorum_rows + config_rows + tick_rows + wal_rows
 
     def _ingress_census_rows(self) -> list[tuple[str, object]]:
         """Per-shard connection census (sharded ingress only): how
@@ -1436,6 +1612,15 @@ class ZKEnsemble:
         self.voters = count
         self.observer_count = (observers if observers is not None
                                else observers_default())
+        #: Construction parameters retained for runtime membership
+        #: changes (README "Dynamic membership"): a joining member is
+        #: built exactly like a boot-time one.
+        self._host = host
+        self._lag = lag
+        self._watchtable = watchtable
+        self._transport = transport
+        self._ingress_shards = ingress_shards
+        self._collector = collector
         #: Quorum-commit: the ack barrier's membership is the VOTERS
         #: alone — attaching observers must not widen (or shrink) the
         #: majority a write waits for.
@@ -1491,6 +1676,50 @@ class ZKEnsemble:
                     gate.note_ack(v, z, self.db.epoch))
             # QUORUM_ACK spans land on the founding leader's ring
             gate.trace = self.servers[0].trace
+        #: Dynamic membership (README "Dynamic membership"): the boot
+        #: config installs as version 0 unless WAL recovery already
+        #: adopted a later one; from here on the database's
+        #: config-change hook re-derives the quorum gate's NAMED
+        #: voter sets and the election coordinator's ballot sets on
+        #: every reconfig record — joint phase included, where both
+        #: planes require majorities of BOTH configs.
+        if self.db.voter_ids is None:
+            self.db.install_config({
+                'version': 0, 'phase': 'final',
+                'voters': tuple(range(count)),
+                'old_voters': None,
+                'observers': tuple(range(
+                    count, count + self.observer_count)),
+            })
+        self.db.on_config_change = (
+            lambda phase, entry: self._config_changed())
+        self._config_changed()
+
+    def _config_changed(self) -> None:
+        """Re-derive every membership consumer from the database's
+        installed config: the quorum gate's named voter sets (member
+        0's vote is the shared database itself — its store IS the db,
+        always current, so ``leader_key`` stays ``member:0`` whoever
+        holds the leader role), the election coordinator's ballot
+        sets, and the ensemble's voting-member count."""
+        db = self.db
+        if db.voter_ids is None:
+            return
+        self.voters = len(db.voter_ids)
+        old = db.old_voter_ids
+        if self.quorum.enabled:
+            self.quorum.total = (max(len(db.voter_ids), len(old))
+                                 if old is not None
+                                 else len(db.voter_ids))
+            self.quorum.set_config(
+                {'member:%d' % i for i in db.voter_ids},
+                ({'member:%d' % i for i in old}
+                 if old is not None else None),
+                leader_key='member:0')
+        if self.election is not None:
+            self.election.set_config(
+                set(db.voter_ids),
+                set(old) if old is not None else None)
 
     @property
     def leader_idx(self) -> int:
@@ -1547,10 +1776,156 @@ class ZKEnsemble:
         with election on, an ex-leader rejoins the CURRENT epoch as a
         follower, never as the leader it once was."""
         await self.servers[idx].restart()
-        if idx >= self.voters:
+        db = self.db
+        if db.voter_ids is not None:
+            is_voter = idx in db.voter_ids or (
+                db.old_voter_ids is not None
+                and idx in db.old_voter_ids)
+        else:
+            is_voter = idx < self.voters
+        if not is_voter:
             self.servers[idx].role = 'observer'
         elif self.election is not None:
             self.election.note_restart(idx)
 
     def addresses(self) -> list[tuple[str, int]]:
         return [s.address for s in self.servers]
+
+    # -- runtime membership changes (README "Dynamic membership") --
+
+    def _spawn_member(self) -> 'ZKServer':
+        """Build one joining member exactly like a boot-time one: a
+        fresh replica bootstraps from a live snapshot of the shared
+        database (the attach-at-tail path — the ensemble has
+        history), wired to the shared quorum gate."""
+        idx = len(self.servers)
+        s = ZKServer(self.db, host=self._host,
+                     store=ReplicaStore(self.db, lag=self._lag),
+                     watchtable=self._watchtable, member=str(idx),
+                     transport=self._transport,
+                     ingress_shards=self._ingress_shards)
+        if self.quorum.enabled:
+            s.quorum = self.quorum
+        if self.election is not None:
+            el = self.election
+            s.elections_ref = el
+            s.fence = (lambda i=idx: i in el.deposed)
+        self.servers.append(s)
+        return s
+
+    async def add_observer(self) -> int:
+        """Observer JOIN under traffic: a new member starts serving a
+        snapshot-bootstrapped replica, then a single final-phase
+        reconfig record (no quorum implications) makes the join
+        durable and visible — client resolvers rebalance on the
+        config-change notification.  Returns the new index."""
+        s = self._spawn_member()
+        s.role = 'observer'
+        idx = len(self.servers) - 1
+        await s.start()
+        self.observer_count += 1
+        db = self.db
+        db.propose_reconfig(db.voter_ids, db.observer_ids + (idx,))
+        return idx
+
+    async def remove_observer(self, idx: int) -> None:
+        """Observer LEAVE: the reconfig record announces the removal
+        first (resolvers rebalance away), then the member drains —
+        open connections close, parking their in-flight read
+        sessions for client-side migration — and its replica
+        detaches from the commit feed."""
+        s = self.servers[idx]
+        if s.role != 'observer':
+            raise ValueError('member %d is a voter' % (idx,))
+        db = self.db
+        if idx not in db.observer_ids:
+            raise ValueError('member %d is not in the config'
+                             % (idx,))
+        db.propose_reconfig(
+            db.voter_ids,
+            tuple(i for i in db.observer_ids if i != idx))
+        await s.stop()
+        if isinstance(s.store, ReplicaStore):
+            s.store.detach()
+        self.observer_count -= 1
+
+    async def reconfig_voters(self, new_voters,
+                              observers=None) -> None:
+        """Voter-set change with joint-majority handoff: the joint
+        record installs C_old+C_new — from its append until the
+        final record's, quorum commit and elections require
+        majorities of BOTH sets, and a removed member can neither
+        ack a quorum nor win a ballot (config-fenced).  A NEW voter
+        index must already be a running member (``add_voter`` /
+        ``replace_voter`` handle join-and-promote).  Leader
+        self-removal is legal: the final record commits under the
+        outgoing leader, which then hands off by election among
+        C_new."""
+        db = self.db
+        new_voters = tuple(sorted(new_voters))
+        obs = (tuple(observers) if observers is not None
+               else tuple(i for i in db.observer_ids
+                          if i not in new_voters))
+        was_voters = db.voter_ids or ()
+        gate = self.quorum
+        # promote ack wiring FIRST: the joint record's own commit
+        # needs C_new's majority to be audible
+        for i in new_voters:
+            if i == 0 or i in was_voters or i >= len(self.servers):
+                continue
+            s = self.servers[i]
+            store = s.store
+            if gate.enabled and isinstance(store, ReplicaStore) \
+                    and store.on_applied is None:
+                store.on_applied = (
+                    lambda z, v='member:%d' % i:
+                    gate.note_ack(v, z, self.db.epoch))
+            s.role = 'follower'
+        entry = db.propose_reconfig(new_voters, obs)
+        if entry[2] == 'final':
+            return
+        if gate.enabled:
+            await gate.wait(entry[6])
+        final = db.commit_reconfig()
+        if gate.enabled:
+            await gate.wait(final[6])
+        # demoted voters leave the ack wiring (the gate's config
+        # fence already discards them) and serve on as observers
+        for i in was_voters:
+            if i in new_voters or i >= len(self.servers):
+                continue
+            s = self.servers[i]
+            if isinstance(s.store, ReplicaStore):
+                s.store.on_applied = None
+            s.role = 'observer'
+        if self.election is not None \
+                and self.election.leader_idx not in new_voters:
+            await self.election.elect('reconfig')
+
+    async def add_voter(self) -> int:
+        """Join-and-promote: start a fresh member (observer-style
+        snapshot bootstrap), then widen the voter set through one
+        joint window.  Returns the new member's index."""
+        s = self._spawn_member()
+        idx = len(self.servers) - 1
+        await s.start()
+        await self.reconfig_voters(self.db.voter_ids + (idx,))
+        return idx
+
+    async def remove_voter(self, idx: int) -> None:
+        """Shrink the voter set through one joint window (leader
+        self-removal included — see :meth:`reconfig_voters`)."""
+        await self.reconfig_voters(
+            tuple(i for i in self.db.voter_ids if i != idx))
+
+    async def replace_voter(self, old_idx: int) -> int:
+        """One joint window swaps a fresh member in for ``old_idx``
+        — the add and the remove hand off atomically.  Returns the
+        new member's index."""
+        s = self._spawn_member()
+        idx = len(self.servers) - 1
+        await s.start()
+        await self.reconfig_voters(
+            tuple(i for i in self.db.voter_ids if i != old_idx)
+            + (idx,))
+        return idx

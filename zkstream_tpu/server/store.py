@@ -233,6 +233,13 @@ class NodeTree(EventEmitter):
             # mirror must carry the table for failover) but never
             # touch the tree
             self._apply_session(entry)
+        elif op == 'reconfig':
+            # membership control record: rides the commit log so every
+            # mirror carries the config for failover, consumes a zxid
+            # (the joint window is bounded by sequenced records), but
+            # never touches the tree
+            self.zxid = max(self.zxid, entry[6])
+            self._apply_reconfig(entry)
         else:  # pragma: no cover - log entries are produced above
             raise AssertionError('unknown log entry %r' % (op,))
 
@@ -242,6 +249,14 @@ class NodeTree(EventEmitter):
         owns the table) ignore them; the cross-process mirror's
         replica overrides this to maintain its leader-handle table
         (server/replication.py RemoteReplicaStore)."""
+
+    def _apply_reconfig(self, entry: tuple) -> None:
+        """Reconfig-record hook, same shape as :meth:`_apply_session`:
+        ignored by a plain tree and an in-process replica (the shared
+        leader database owns the config); the cross-process mirror's
+        replica overrides it so a promoted follower inherits the
+        membership config — including an in-progress joint window —
+        from its replicated log (server/replication.py)."""
 
     def _apply_create(self, path: str, data: bytes, acl: tuple,
                       ephemeral_owner: int, zxid: int, now: int) -> None:
@@ -355,6 +370,25 @@ class ZKDatabase(NodeTree):
         self.multi_batches = 0
         self.multi_subops = 0
         self._replicas: list['ReplicaStore'] = []
+        #: Dynamic membership (reconfig control records).  ``None``
+        #: voter_ids = never configured: the boot-time shape stands
+        #: and quorum math stays count-based (the legacy path, bit-
+        #: identical to pre-reconfig behavior).  During a joint window
+        #: ``old_voter_ids`` holds C_old — quorum-commit and elections
+        #: need majorities of BOTH sets until the final record commits.
+        self.config_version = 0
+        self.voter_ids: tuple | None = None
+        self.old_voter_ids: tuple | None = None
+        self.observer_ids: tuple = ()
+        #: completed membership changes (mntr zk_reconfig_total)
+        self.reconfig_total = 0
+        #: epoch of the last completed VOTER change — the at-most-one-
+        #: membership-change-per-epoch guard (invariant 7 extension)
+        self.reconfig_epoch = -1
+        #: hook called with (phase, entry) after each reconfig record
+        #: commits — the owner (ZKEnsemble / run_member) repoints the
+        #: QuorumGate voter sets, election tallies and client resolver
+        self.on_config_change = None
         # Like real ZK's (timestamp << 24) seed, masked into int64 range.
         self._next_session = ((int(time.time() * 1000) << 24)
                               & 0x7fffffffffff0000)
@@ -393,6 +427,127 @@ class ZKDatabase(NodeTree):
             # deposed-then-restarted leader that lost the bump would
             # come back believing its stale epoch
             self.wal.sync_for_flush()
+
+    # -- dynamic membership (reconfig control records) --
+
+    def install_config(self, cfg: dict) -> None:
+        """Adopt a membership config wholesale — the boot-time shape
+        (ZKEnsemble), a WAL-recovered one (server/persist.py), or a
+        promoted mirror's replicated one (server/replication.py)."""
+        self.config_version = cfg.get('version', 0)
+        voters = cfg.get('voters')
+        self.voter_ids = tuple(voters) if voters is not None else None
+        old = cfg.get('old_voters')
+        self.old_voter_ids = tuple(old) if old else None
+        self.observer_ids = tuple(cfg.get('observers') or ())
+
+    def config_snapshot(self) -> dict | None:
+        """The membership config in its durable form — what a format-3
+        snapshot stamps and recovery adopts (server/persist.py); None
+        until the ensemble is configured (legacy images stay
+        byte-compatible)."""
+        if self.voter_ids is None:
+            return None
+        return {'version': self.config_version,
+                'phase': ('joint' if self.old_voter_ids is not None
+                          else 'final'),
+                'voters': self.voter_ids,
+                'old_voters': self.old_voter_ids,
+                'observers': self.observer_ids}
+
+    def joint_config(self) -> tuple | None:
+        """(C_old, C_new) while a joint window stands, else None."""
+        if self.old_voter_ids is None:
+            return None
+        return (self.old_voter_ids, self.voter_ids)
+
+    def propose_reconfig(self, new_voters, observers=None) -> tuple:
+        """Begin a membership change: commit the phase-'joint' WAL
+        CONTROL record installing C_old+C_new.  From this record's
+        commit until :meth:`commit_reconfig`'s final record, quorum
+        commit and elections must hold majorities of BOTH voter sets
+        (server/replication.py QuorumGate, server/election.py).  An
+        observer-only change (voter set unchanged) has no quorum
+        implications and commits a single 'final' record directly.
+        Returns the committed entry."""
+        if self.voter_ids is None:
+            raise ValueError('ensemble has no installed config')
+        if self.old_voter_ids is not None:
+            raise ValueError(
+                'reconfig already in progress (config version %d is '
+                'joint)' % (self.config_version,))
+        new_voters = tuple(new_voters)
+        observers = (tuple(observers) if observers is not None
+                     else self.observer_ids)
+        voters_change = set(new_voters) != set(self.voter_ids)
+        if voters_change and self.reconfig_epoch == self.epoch:
+            # at most one voter-set change per epoch (invariant 7
+            # extension): a second change must wait for an epoch bump
+            raise ValueError(
+                'voter set already changed in epoch %d'
+                % (self.epoch,))
+        if voters_change and not new_voters:
+            raise ValueError('cannot reconfig to an empty voter set')
+        old = self.voter_ids
+        phase = 'joint' if voters_change else 'final'
+        if self.trace is not None:
+            self.trace.note('RECONFIG', zxid=self.zxid, kind='server',
+                            detail='propose v%d %s'
+                            % (self.config_version + 1, phase))
+        self.config_version += 1
+        if voters_change:
+            self.old_voter_ids = old
+        self.voter_ids = new_voters
+        self.observer_ids = observers
+        zxid = self.next_zxid()
+        entry = ('reconfig', self.config_version, phase,
+                 tuple(old) if voters_change else (), new_voters,
+                 observers, zxid)
+        # the config governs from APPEND, not commit (joint
+        # consensus): the hook re-derives the quorum/ballot sets
+        # BEFORE the record commits, so the joint record itself must
+        # clear majorities of both configs — and a just-promoted
+        # voter's ack of this very record is counted, not fenced
+        hook = self.on_config_change
+        if hook is not None:
+            hook(phase, entry)
+        self._commit(entry)
+        if self.trace is not None:
+            self.trace.note('RECONFIG', zxid=zxid, kind='server',
+                            detail='%s v%d voters=%s'
+                            % (phase, self.config_version,
+                               ','.join(map(str, new_voters))))
+        if not voters_change:
+            self.reconfig_total += 1
+        return entry
+
+    def commit_reconfig(self) -> tuple:
+        """Close the joint window: commit the phase-'final' record —
+        C_new alone governs from here, and removed members can neither
+        ack a quorum nor win a ballot.  A leader promoted over a WAL
+        holding an in-progress joint record calls this to finish the
+        interrupted reconfig (server/election.py run_member)."""
+        if self.old_voter_ids is None:
+            raise ValueError('no reconfig in progress')
+        self.old_voter_ids = None
+        self.config_version += 1
+        zxid = self.next_zxid()
+        entry = ('reconfig', self.config_version, 'final', (),
+                 self.voter_ids, self.observer_ids, zxid)
+        # same append-time rule as propose_reconfig: C_new alone
+        # governs the final record's own commit
+        hook = self.on_config_change
+        if hook is not None:
+            hook('final', entry)
+        self._commit(entry)
+        self.reconfig_total += 1
+        self.reconfig_epoch = self.epoch
+        if self.trace is not None:
+            self.trace.note('RECONFIG', zxid=zxid, kind='server',
+                            detail='commit v%d voters=%s'
+                            % (self.config_version,
+                               ','.join(map(str, self.voter_ids))))
+        return entry
 
     def attach_replica_at_tail(self, replica) -> int:
         """Attach a replica that is bootstrapped from a snapshot (the
@@ -502,6 +657,8 @@ class ZKDatabase(NodeTree):
         self.nodes = rec.nodes
         self.zxid = rec.zxid
         self.epoch = max(self.epoch, rec.epoch)
+        if rec.config is not None:
+            self.install_config(rec.config)
         self.log.clear()
         self.log_base = 0
         self.log_start_zxid = rec.zxid
@@ -519,10 +676,13 @@ class ZKDatabase(NodeTree):
             self._multi_buf.append(entry)
             return
         if self.trace is not None \
-                and entry[0] not in ('session', 'session_close'):
+                and entry[0] not in ('session', 'session_close',
+                                     'reconfig'):
             # session control records are edges, not transactions:
             # they consume no zxid, so a COMMIT span would break the
-            # zxid-keyed chain (and stamp zxid 0 on a fresh database)
+            # zxid-keyed chain (and stamp zxid 0 on a fresh database);
+            # reconfig records get their own RECONFIG span chain
+            # (propose -> joint -> commit) instead
             if entry[0] == 'multi':
                 self.trace.note('COMMIT', None,
                                 zxid=entry_zxid(entry), kind='server',
@@ -943,6 +1103,19 @@ class ReplicaStore(NodeTree):
         """Apply everything committed so far — what a write through
         this member does so its author can read their own write."""
         self._apply_until(self.leader.log_end())
+
+    def detach(self) -> None:
+        """Unhook from the leader's commit feed — the observer-leave
+        half of a membership change (README "Dynamic membership"):
+        no further entries are pushed to this replica, and its
+        ``applied`` floor stops pinning the leader's log truncation.
+        Idempotent."""
+        ldr = self.leader
+        ldr.remove_listener('committed', self._on_commit)
+        try:
+            ldr._replicas.remove(self)
+        except ValueError:
+            pass
 
     def sync_flush(self) -> None:
         """The ``sync`` op's barrier: for an in-process replica the
