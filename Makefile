@@ -11,7 +11,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
     reconfig \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
-    bench-read bench-reconfig \
+    bench-read bench-reconfig bench-blackbox \
     timeline coverage clean
 
 all: check test
@@ -168,11 +168,13 @@ bench-read:
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), causal tracing (client spans + member rings + the
-# zxid-merged timeline), the tick ledger, and the four-letter admin
-# words (ruok/mntr/stat/srvr/trce) — see README "Observability".
+# zxid-merged timeline), the tick ledger, the four-letter admin
+# words (ruok/mntr/stat/srvr/trce), and the black-box plane (crash-
+# durable flight recorder + slow-op digest + `top` collector) — see
+# README "Observability".
 obs:
 	$(PYTHON) -m pytest tests/test_metrics.py tests/test_trace.py \
-	    tests/test_admin_words.py -q
+	    tests/test_admin_words.py tests/test_blackbox.py -q
 
 # Causal-tracing demo: run one traced write through an in-process
 # 3-member ensemble (WAL on, watch armed) and print the merged
@@ -190,6 +192,14 @@ timeline:
 # ZKSTREAM_BENCH_TRACE_ROUNDS.
 bench-trace:
 	$(PYTHON) bench.py --traceov
+
+# Paired black-box-plane overhead envelope: the crash-durable flight
+# recorder + slow-op digest (the default) vs ZKSTREAM_NO_BLACKBOX=1,
+# WAL-backed write-heavy cells at fleet 16/64 with exact sign tests —
+# acceptance bar "not significantly slower at any cell" (table in
+# PROFILE.md).  Rounds via ZKSTREAM_BENCH_BLACKBOX_ROUNDS.
+bench-blackbox:
+	$(PYTHON) bench.py --blackbox
 
 # Linearizability plane (analysis/linearize.py; README
 # "Linearizability"): the checker's own violation corpus

@@ -1653,6 +1653,99 @@ def bench_trace_overhead() -> None:
         }), flush=True)
 
 
+#: `bench.py --blackbox` fleet sizes (the acceptance envelope: the
+#: flight recorder — periodic frames + slow-op digest off the hot
+#: path — must not be significantly slower than the recorder-off arm
+#: at either scale).
+BLACKBOX_SCALES = (16, 64)
+
+
+def bench_blackbox_overhead() -> None:
+    """The black-box plane's cost envelope (`make bench-blackbox`):
+    paired write-heavy WAL-backed cells — flight recorder on (the
+    default: periodic snapshot frames + slow-op digest, written on
+    the executor) vs ``ZKSTREAM_NO_BLACKBOX=1`` — at fleet 16/64.
+    WAL 'tick' cells on purpose: only a server with a wal_dir has a
+    recorder at all, and the recorder shares the executor with the
+    group fsync — the one interaction that could plausibly cost.
+    Per-round adjacent A/B with the arm order ALTERNATING per round
+    (the first-slot penalty rationale in bench_trace_overhead), sign
+    of the per-round set-ops/s delta, exact two-sided sign test —
+    the PROFILE.md methodology shared by every paired family."""
+    import asyncio
+
+    from zkstream_tpu.utils import native
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    mode = 'native' if native.ensure_lib() is not None else 'python'
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_BLACKBOX_ROUNDS',
+                                '10'))
+    # both arm states forced explicitly, ambient value restored — an
+    # inherited ZKSTREAM_NO_BLACKBOX=1 would silently turn the
+    # recorded arm into a second unrecorded one
+    ambient = os.environ.get('ZKSTREAM_NO_BLACKBOX')
+    rows: dict = {}
+    cells: dict = {}
+    try:
+        for rnd in range(rounds):
+            arms = (('blackbox', 'nobox') if rnd % 2 == 0
+                    else ('nobox', 'blackbox'))
+            for n in BLACKBOX_SCALES:
+                pair: dict = {}
+                for arm in arms:
+                    if arm == 'nobox':
+                        os.environ['ZKSTREAM_NO_BLACKBOX'] = '1'
+                    else:
+                        os.environ.pop('ZKSTREAM_NO_BLACKBOX', None)
+                    try:
+                        r = asyncio.run(_client_ops_run(
+                            mode, n, write_heavy=True, wal='tick'))
+                    except Exception as e:
+                        print('# blackbox cell %s@%d round failed: '
+                              '%r' % (arm, n, e), file=sys.stderr)
+                        continue
+                    r['blackbox_arm'] = arm
+                    pair[arm] = r
+                for arm, r in pair.items():
+                    key = (n, arm)
+                    if len(pair) == 2:
+                        # adjacent pairs only: a round where either
+                        # arm failed contributes to neither
+                        rows.setdefault(key, []).append(
+                            r['set']['ops_per_sec'])
+                    if key not in cells or r['set']['ops_per_sec'] \
+                            > cells[key]['set']['ops_per_sec']:
+                        cells[key] = r
+    finally:
+        if ambient is None:
+            os.environ.pop('ZKSTREAM_NO_BLACKBOX', None)
+        else:
+            os.environ['ZKSTREAM_NO_BLACKBOX'] = ambient
+    for key in sorted(cells, key=str):
+        print('# blackbox_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for n in BLACKBOX_SCALES:
+        a = rows.get((n, 'blackbox'), [])
+        b = rows.get((n, 'nobox'), [])
+        if not a or not b:
+            continue
+        paired = list(zip(a, b))
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired if x > y)
+        losses = sum(1 for x, y in paired if x < y)
+        print(json.dumps({
+            'metric': 'blackbox_plane_sign_test',
+            'pair': 'blackbox-vs-off',
+            'conns': n,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --fanout` sweep (the serving-plane cell family): sessions
 #: on the box x watchers on the hot path.  -1 = every session watches.
 FANOUT_SESSIONS = (1000, 10000, 100000)
@@ -2779,6 +2872,15 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_trace_overhead()
+        return
+    if '--blackbox' in sys.argv:
+        # `make bench-blackbox`: the paired black-box-plane overhead
+        # family (flight recorder + slow-op digest vs
+        # ZKSTREAM_NO_BLACKBOX=1, WAL-backed write-heavy cells).
+        # Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_blackbox_overhead()
         return
     if '--transport' in sys.argv:
         # `make bench-transport`: the batched-syscall transport-tier

@@ -141,6 +141,38 @@ async def test_mntr_tick_ledger_and_trace_rows(server):
         await c.close()
 
 
+async def test_mntr_uptime_slow_op_and_blackbox_rows(server,
+                                                     tmp_path):
+    """The black-box plane's mntr rows: zk_uptime_ms and
+    zk_slow_ops_total on EVERY member (0 slow ops at the default
+    threshold — the clean-schedule invariant); zk_blackbox_frames /
+    zk_blackbox_bytes only where a flight recorder actually writes
+    (a member with a wal_dir)."""
+    from zkstream_tpu.server import ZKServer
+
+    text = (await _four_letter(server, b'mntr')).decode()
+    kv = dict(line.split('\t', 1)
+              for line in text.strip().splitlines())
+    assert int(kv['zk_uptime_ms']) >= 0
+    assert int(kv['zk_slow_ops_total']) == 0
+    # no wal_dir -> no recorder -> no frame rows (mntr never lies)
+    assert 'zk_blackbox_frames' not in kv
+    assert 'zk_blackbox_bytes' not in kv
+
+    srv = await ZKServer(wal_dir=str(tmp_path / 'wal')).start()
+    try:
+        assert srv.blackbox is not None
+        srv.blackbox.capture()       # one frame now, cadence aside
+        text = (await _four_letter(srv, b'mntr')).decode()
+        kv = dict(line.split('\t', 1)
+                  for line in text.strip().splitlines())
+        assert int(kv['zk_blackbox_frames']) >= 1
+        assert int(kv['zk_blackbox_bytes']) >= 0
+        assert int(kv['zk_slow_ops_total']) == 0
+    finally:
+        await srv.stop()
+
+
 async def test_trce_word_dumps_member_ring(server):
     """trce: the member's span ring as trace_schema-stamped JSON —
     what `timeline --live` merges across members."""

@@ -357,6 +357,37 @@ def build_parser() -> argparse.ArgumentParser:
                     help='also list every decoded record '
                          '(index, zxid, op, path, bytes)')
 
+    bb = sub.add_parser(
+        'blackbox',
+        help='verify and render the flight-recorder rings in a WAL '
+             'directory (utils/blackbox.py): per-member frame '
+             'listing with CRC32C verification — a dead member\'s '
+             'last mntr counters, tick phases, FSM census and span '
+             'tail.  Torn final frame tolerated (the crash '
+             'signature), bit flips rejected; no server, no session')
+    bb.add_argument('dir', help='the member\'s wal_dir (the rings '
+                                'are blackbox.<member>.log '
+                                'co-tenants of the WAL)')
+    bb.add_argument('--json', dest='as_json', action='store_true',
+                    help='emit blackbox_schema-stamped JSON (every '
+                         'frame) instead of the text summary')
+
+    tp = sub.add_parser(
+        'top',
+        help='continuous fleet collector: poll mntr across every '
+             '--server member, render live per-member deltas (role, '
+             'epoch, config version, slow ops, quorum degradations) '
+             'and optionally append a top_schema-stamped JSONL '
+             'time-series — point-in-time scrapes become '
+             'trajectories (works against OS-process members)')
+    tp.add_argument('--interval', type=float, default=2.0,
+                    help='seconds between polls (default 2)')
+    tp.add_argument('--count', type=int, default=0,
+                    help='stop after N polls (default: forever)')
+    tp.add_argument('--out', metavar='PATH', default=None,
+                    help='append one JSON line per member per poll '
+                         '(top_schema-stamped) to PATH')
+
     an = sub.add_parser(
         'analyze',
         help='run the semantic static-analysis tier '
@@ -768,6 +799,7 @@ async def _timeline(args) -> int:
 
     if args.live:
         rings: dict = {}
+        dropped: dict = {}
         failed = 0
         for spec in args.server:
             host, port = spec['address'], spec['port']
@@ -789,14 +821,23 @@ async def _timeline(args) -> int:
                 key = 'member:%s@%s:%d' % (dump.get('member', port),
                                            host, port)
             rings[key] = dump.get('spans', [])
+            # the ring is bounded: a wrapped ring silently lost spans
+            # before this scrape — surface the count next to the ring
+            # (the zk_trace_ring_dropped mntr row, per member)
+            dropped[key] = dump.get('dropped', 0)
         if failed and not rings:
             return 1
         merged = merge_timelines(rings)
         if args.as_json:
             print(_json.dumps({'trace_schema': TRACE_SCHEMA,
-                               'rings': rings, 'timeline': merged},
+                               'rings': rings, 'dropped': dropped,
+                               'timeline': merged},
                               indent=2))
         else:
+            for key in sorted(rings):
+                print('# %s: %d span(s), %d dropped (ring '
+                      'overwrites)' % (key, len(rings[key]),
+                                       dropped.get(key, 0)))
             print(format_timeline(merged) or '(no zxid-keyed spans)')
         return 1 if failed else 0
 
@@ -951,6 +992,178 @@ def _wal(args) -> int:
     return 0
 
 
+def _blackbox(args) -> int:
+    """Verify/render the flight-recorder rings of a WAL directory
+    through the same scan recovery uses (utils/blackbox.py
+    ``read_box``), so the CLI and the harvest path can never disagree
+    on what is valid.  Exit 0 when every ring is recoverable (a torn
+    FINAL frame is the normal crash signature and is tolerated); exit
+    1 on structural corruption (a CRC failure, a torn rotated half)
+    or when the directory holds no rings at all."""
+    import json as _json
+
+    from .utils.blackbox import BLACKBOX_SCHEMA, list_boxes, read_box
+
+    members = list_boxes(args.dir)
+    if not members:
+        print('no black-box rings in %s' % (args.dir,),
+              file=sys.stderr)
+        return 1
+    corrupt = 0
+    out = []
+    for member in members:
+        box = read_box(args.dir, member)
+        if box['status'] not in ('ok', 'torn'):
+            corrupt += 1
+        out.append(box)
+    if args.as_json:
+        print(_json.dumps({
+            'blackbox_schema': BLACKBOX_SCHEMA,
+            'dir': args.dir,
+            'members': [{
+                'member': b['member'], 'status': b['status'],
+                'files': [{'path': os.path.basename(f.path),
+                           'status': f.status, 'error': f.error,
+                           'frames': len(f.frames),
+                           'valid_bytes': f.valid_bytes,
+                           'size': f.size} for f in b['files']],
+                'frames': b['frames'],
+            } for b in out]}, indent=2))
+        return 1 if corrupt else 0
+    print('blackbox dir: %s' % (args.dir,))
+    for box in out:
+        print('member %s: %d frame(s), status %s'
+              % (box['member'], len(box['frames']), box['status']))
+        for f in box['files']:
+            note = 'ok' if f.status == 'ok' else (
+                '%s@%d (%s)' % (f.status, f.valid_bytes, f.error))
+            print('  %-28s frames=%-5d bytes=%-8d %s'
+                  % (os.path.basename(f.path), len(f.frames),
+                     f.size, note))
+        for fr in box['frames']:
+            mntr = fr.get('mntr') or {}
+            slow = fr.get('slow')
+            extra = ''
+            if fr.get('phases'):
+                extra += ' phases=%d' % (len(fr['phases']),)
+            if fr.get('trace_tail') is not None:
+                extra += ' spans=%d' % (len(fr['trace_tail']),)
+            if slow is not None:
+                extra += ' slow=%s %.1fms chain=%d' % (
+                    slow.get('op'), slow.get('duration_ms') or 0.0,
+                    len(fr.get('chain') or ()))
+            print('    #%-5d %-8s role=%-9s zxid=%-8s slow_ops=%-4s'
+                  '%s'
+                  % (fr.get('seq', -1), fr.get('kind'),
+                     mntr.get('zk_member_role', '-'),
+                     mntr.get('zk_zxid', '-'),
+                     mntr.get('zk_slow_ops_total', '-'), extra))
+    if corrupt:
+        print('status: STRUCTURAL CORRUPTION (%d ring(s)); harvest '
+              'stops at each last valid prefix' % (corrupt,),
+              file=sys.stderr)
+        return 1
+    torn = any(b['status'] == 'torn' for b in out)
+    print('status: clean%s'
+          % (' (torn final frame tolerated)' if torn else ''))
+    return 0
+
+
+def _parse_mntr_text(text: str) -> dict:
+    """mntr reply lines ('key\\tvalue') to a dict, values coerced to
+    int/float where they parse."""
+    rows: dict = {}
+    for line in text.splitlines():
+        if '\t' not in line:
+            continue
+        key, _, val = line.partition('\t')
+        for conv in (int, float):
+            try:
+                rows[key] = conv(val)
+                break
+            except ValueError:
+                continue
+        else:
+            rows[key] = val
+    return rows
+
+
+async def _top(args) -> int:
+    """The continuous fleet collector: one mntr scrape per member per
+    interval, per-member delta rendering, optional JSONL append
+    (top_schema-stamped, one line per member per poll) — the
+    trajectory view the point-in-time words cannot give.  Exit 0 once
+    stopped (--count or ctrl-c) if any member ever answered."""
+    import json as _json
+    import time as _time
+
+    from .utils.blackbox import TOP_SCHEMA
+
+    #: counters whose per-interval delta is the interesting number
+    deltas = ('zk_packets_received', 'zk_packets_sent',
+              'zk_slow_ops_total', 'zk_quorum_degraded',
+              'zk_blackbox_frames', 'zk_trace_ring_dropped')
+    prev: dict = {}
+    ever = 0
+    polls = 0
+    out_f = open(args.out, 'a') if args.out else None
+    try:
+        while True:
+            stamp = _time.strftime('%H:%M:%S')
+            for spec in args.server:
+                host, port = spec['address'], spec['port']
+                who = '%s:%d' % (host, port)
+                try:
+                    raw = await _admin_one(host, port, 'mntr',
+                                           args.timeout)
+                    rows = _parse_mntr_text(
+                        raw.decode('utf-8', 'replace'))
+                except (OSError, asyncio.TimeoutError,
+                        TimeoutError):
+                    print('%s %-21s unreachable' % (stamp, who))
+                    continue
+                ever += 1
+                last = prev.get(who) or {}
+                moved = []
+                for key in deltas:
+                    cur = rows.get(key)
+                    if not isinstance(cur, (int, float)):
+                        continue
+                    base = last.get(key)
+                    d = (cur - base
+                         if isinstance(base, (int, float)) else cur)
+                    moved.append('%s+%g'
+                                 % (key.replace('zk_', ''), d))
+                prev[who] = rows
+                print('%s %-21s %-9s epoch=%-3s cfg=%-3s '
+                      'zxid=%-10s conns=%-5s %s'
+                      % (stamp, who,
+                         rows.get('zk_member_role', '?'),
+                         rows.get('zk_epoch', '?'),
+                         rows.get('zk_config_version', '-'),
+                         rows.get('zk_zxid', '?'),
+                         rows.get('zk_num_alive_connections', '?'),
+                         ' '.join(moved)))
+                if out_f is not None:
+                    out_f.write(_json.dumps({
+                        'top_schema': TOP_SCHEMA,
+                        't_wall': round(_time.time(), 3),
+                        'member': who,
+                        'mntr': rows}) + '\n')
+            if out_f is not None:
+                out_f.flush()
+            polls += 1
+            if args.count and polls >= args.count:
+                break
+            await asyncio.sleep(args.interval)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        if out_f is not None:
+            out_f.close()
+    return 0 if ever else 1
+
+
 def _analyze(args) -> int:
     """The contract-lint tier as a subcommand: JSON findings with
     file:line positions (schema-stamped, like every other machine
@@ -982,6 +1195,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == 'wal':
         # offline directory inspection: no server, no event loop
         return _wal(args)
+    if args.cmd == 'blackbox':
+        # offline flight-recorder inspection: no server, no loop
+        return _blackbox(args)
+    if args.cmd == 'top':
+        # raw mntr polling loop: no client, no session
+        return asyncio.run(_top(args))
     if args.cmd == 'mntr':
         # raw four-letter-word scrape: no client, no session
         return asyncio.run(_admin(args))

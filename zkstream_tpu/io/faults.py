@@ -665,6 +665,18 @@ def _note_open_spans(res: 'ScheduleResult', trace) -> None:
                          for s in leaked[:8])))
 
 
+def _harvest_blackboxes(wal_dir: str) -> dict:
+    """Lift every flight-recorder ring out of a schedule's wal_dir
+    (utils/blackbox.py) before teardown removes it — the dead
+    member's last spans, `merge_timelines`-ready.  Best-effort:
+    salvage must never turn a passing schedule into an error."""
+    try:
+        from ..utils.blackbox import harvest_spans
+        return harvest_spans(wal_dir)
+    except Exception:
+        return {}
+
+
 @dataclasses.dataclass
 class ScheduleResult:
     seed: int
@@ -924,6 +936,9 @@ async def run_schedule(seed: int, ops: int = 6,
         await srv.stop()
         if srv.db.wal is not None:
             srv.db.wal.close()
+        # black-box harvest before the wal_dir goes: a crash-phase
+        # restart may have lost in-memory spans this ring still holds
+        salvaged = _harvest_blackboxes(wal_dir)
         shutil.rmtree(wal_dir, ignore_errors=True)
         shutil.rmtree(crash_dir, ignore_errors=True)
         inj.close()
@@ -933,6 +948,8 @@ async def run_schedule(seed: int, ops: int = 6,
         if srv.trace is not None:
             res.member_rings = {
                 'member:%s' % (srv.member,): srv.trace.dump()}
+        for key, spans in salvaged.items():
+            res.member_rings.setdefault(key, spans)
 
 
 async def run_campaign(base_seed: int, schedules: int,
@@ -1902,6 +1919,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         inj.close()
         if ingest is not None:
             ingest.close()
+        salvaged = _harvest_blackboxes(wal_dir)
         shutil.rmtree(wal_dir, ignore_errors=True)
         shutil.rmtree(crash_dir, ignore_errors=True)
         _note_open_spans(res, client.trace)
@@ -1909,6 +1927,10 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         res.member_rings = {
             'member:%s' % (s.member,): s.trace.dump()
             for s in ens.servers if s.trace is not None}
+        # harvested black boxes fill only the gaps: a live member's
+        # ring dump is fresher than its on-disk frames
+        for key, spans in salvaged.items():
+            res.member_rings.setdefault(key, spans)
         res.history = list(h.records)
         # derived, never dual-appended: the history's member records
         # ARE the timeline
@@ -2438,6 +2460,7 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         inj.close()
         if ingest is not None:
             ingest.close()
+        salvaged = _harvest_blackboxes(wal_dir)
         shutil.rmtree(wal_dir, ignore_errors=True)
         shutil.rmtree(crash_dir, ignore_errors=True)
         for c in cls:
@@ -2446,6 +2469,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         res.member_rings = {
             'member:%s' % (s.member,): s.trace.dump()
             for s in ens.servers if s.trace is not None}
+        for key, spans in salvaged.items():
+            res.member_rings.setdefault(key, spans)
         res.history = list(h.records)
         res.member_events = h.member_timeline()
         res.elections = sum(1 for r in h.records

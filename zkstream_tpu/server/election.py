@@ -805,7 +805,8 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             peer.note_leading(svc.port)
             if server is None:
                 srv = ZKServer(db, port=client_port,
-                               member='m%d' % (member_id,))
+                               member='m%d' % (member_id,),
+                               blackbox_dir=wal_dir)
                 srv.quorum = svc.quorum
                 announce(await srv.start())
             else:
@@ -953,7 +954,8 @@ async def run_member(member_id: int, wal_dir: str, client_port: int,
             if server is None:
                 srv = await ZKServer(
                     remote, store=store, port=client_port,
-                    member='m%d' % (member_id,)).start()
+                    member='m%d' % (member_id,),
+                    blackbox_dir=wal_dir).start()
                 srv.role = member_role
                 announce(srv)
             else:
@@ -1624,6 +1626,20 @@ async def run_process_schedule(seed: int, ops: int = 6,
                 pass
         res.history = list(h.records)
         res.member_events = h.member_timeline()
+        # black-box harvest (utils/blackbox.py): every member of this
+        # tier — the SIGKILL'd ones especially — left a flight-
+        # recorder ring in its wal_dir; lift the dead fleet's last
+        # spans into member_rings before the root is torn down, so
+        # the OS-process tier's --trace-out timeline has member rings
+        # at all (its servers live in child processes, so the
+        # in-process ring dump path never sees them)
+        from ..utils.blackbox import harvest_spans
+        for m in fleet:
+            try:
+                for key, spans in harvest_spans(m.wal_dir).items():
+                    res.member_rings.setdefault(key, spans)
+            except Exception:
+                pass                  # salvage is best-effort
         if own_root:
             import shutil
             shutil.rmtree(root, ignore_errors=True)

@@ -19,6 +19,14 @@ peer spec suffixed ``:observer`` marks that PEER as one, so the
 voting total this member elects against excludes it.
 ``ZKSTREAM_MEMBER_SYNC`` picks the WAL fsync policy (default
 ``tick``).
+
+Each member keeps a black-box flight recorder
+(utils/blackbox.py) in its WAL_DIR: when the harness SIGKILLs this
+process, the harvest pass lifts the durable frames — last mntr
+counters, tick phases, span tail — back into the schedule's merged
+timeline, and ``python -m zkstream_tpu blackbox WAL_DIR`` renders
+them by hand.  ``ZKSTREAM_NO_BLACKBOX=1`` disables the recorder,
+``ZKSTREAM_BLACKBOX_MS`` its cadence.
 """
 
 from __future__ import annotations

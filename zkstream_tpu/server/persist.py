@@ -569,7 +569,14 @@ def _read_snapshot(path: str, load_nodes: bool = True) -> SnapshotInfo:
 def scan_dir(path: str, with_entries: bool = True,
              load_snapshots: bool = True) -> WalScan:
     """Inventory a WAL directory.  Never mutates it — reopening for
-    writes (``WriteAheadLog``) is what truncates a torn tail."""
+    writes (``WriteAheadLog``) is what truncates a torn tail.
+
+    Co-tenancy contract: only the ``wal.``/``snap.`` prefixes belong
+    to this module.  The black-box flight recorder
+    (utils/blackbox.py) keeps its ``blackbox.<member>.log`` rings in
+    the same directory, invisible to this scan and to
+    :func:`reset_dir` — a member's telemetry must survive its own
+    snapshot bootstrap."""
     segments, snapshots = [], []
     try:
         names = sorted(os.listdir(path))
@@ -1451,7 +1458,9 @@ def reset_dir(path: str) -> None:
     follower does when the leader bootstraps it from a snapshot
     despite its recovered state (the on-disk history is then stale
     relative to the installed image and must not be replayed over
-    it)."""
+    it).  Prefix-scoped on purpose: a co-tenant ``blackbox.*`` ring
+    (utils/blackbox.py) records a history of the member, not of the
+    tree — bootstrap must not erase it."""
     try:
         names = os.listdir(path)
     except FileNotFoundError:

@@ -893,7 +893,9 @@ class ZKServer:
                  transport: str | None = None,
                  flush_cap: int | None = None,
                  ingress_shards: int | None = None,
-                 ingress_backend: str | None = None):
+                 ingress_backend: str | None = None,
+                 blackbox: bool | None = None,
+                 blackbox_dir: str | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -1081,6 +1083,36 @@ class ZKServer:
         #: steady-state metric inventory is unchanged when dynamic
         #: membership is never exercised).
         self._rcfg_hist = None
+        #: The ``zk_uptime_ms`` epoch (construction, like real ZK's
+        #: server start).
+        self._started_at = time.monotonic()
+        #: The black-box plane (utils/blackbox.py): a crash-durable
+        #: flight recorder co-tenant in this member's WAL directory —
+        #: only a member with one has somewhere durable to write.
+        #: ``blackbox=`` forces on/off (``ZKSTREAM_NO_BLACKBOX=1`` is
+        #: the process default / kill switch); ``blackbox_dir=`` gives
+        #: a member without its own WAL (ensemble followers share the
+        #: leader's log; OS-process members own a wal_dir either way)
+        #: a ring of its own.
+        from ..utils.blackbox import (
+            BlackBoxRecorder,
+            blackbox_enabled,
+            slow_op_ms,
+        )
+        enabled_bb = (blackbox_enabled() if blackbox is None
+                      else blackbox)
+        bb_dir = blackbox_dir
+        if bb_dir is None and self._owns_wal:
+            bb_dir = self.db.wal.dir
+        self.blackbox = (BlackBoxRecorder(bb_dir, member=self.member,
+                                          server=self,
+                                          collector=collector)
+                         if enabled_bb and bb_dir else None)
+        if self.blackbox is not None and self.trace is not None:
+            # the slow-op digest: spans settled on this member's ring
+            # at/over the threshold get their causal chain persisted
+            self.trace.slow_ms = slow_op_ms()
+            self.trace.on_slow = self.blackbox.slow_span
 
     @property
     def ack_barrier(self):
@@ -1136,6 +1168,8 @@ class ZKServer:
     BACKLOG = 1024
 
     async def start(self) -> 'ZKServer':
+        if self.blackbox is not None:
+            self.blackbox.start(asyncio.get_running_loop())
         if self.ingress is not None:
             # sharded ingress: per-shard SO_REUSEPORT listeners (or
             # the dispatcher handoff) + batched receive drains; the
@@ -1196,6 +1230,11 @@ class ZKServer:
             # connection's transport teardown has run before stop()
             # returns, so an in-process peer observes the close
             await self.ingress.wait_closed()
+        if self.blackbox is not None:
+            # clean stop: cancel the cadence, drain queued frames and
+            # flush one fsynced final frame (a SIGKILL never gets
+            # here — the ring's torn tail is that story)
+            self.blackbox.stop()
         if self._owns_wal and not self.db.wal.closed:
             self.db.wal.close()
         if self.transport_tier is not None:
@@ -1227,6 +1266,8 @@ class ZKServer:
         elif self.db.wal is not None and self.db.wal.closed:
             self.db.wal.reopen()     # stop() closed it with the member
         self.store.catch_up()
+        if self.blackbox is not None:
+            self.blackbox.start(asyncio.get_running_loop())
         if self.ingress is not None:
             self.ingress.start(self.host, self.port)
             return self
@@ -1463,6 +1504,19 @@ class ZKServer:
         if self.trace is not None:
             tick_rows.append(('zk_trace_ring_dropped',
                               self.trace.dropped))
+        # black-box plane rows (utils/blackbox.py): the slow-op count
+        # is ALWAYS present (0 with the recorder off — the clean-
+        # schedule invariant asserts on it either way); frame/byte
+        # rows only when a recorder is actually writing
+        bb = self.blackbox
+        blackbox_rows: list[tuple[str, object]] = [
+            ('zk_slow_ops_total', 0 if bb is None else bb.slow_ops),
+        ]
+        if bb is not None:
+            blackbox_rows += [
+                ('zk_blackbox_frames', bb.frames),
+                ('zk_blackbox_bytes', bb.bytes_written),
+            ]
         if self.ledger is not None:
             tick_rows.append(('zk_tick_count', self.ledger.ticks))
             for phase in TickLedger.PHASES:
@@ -1473,6 +1527,8 @@ class ZKServer:
                          round(p99, 4)))
         return [
             ('zk_version', 'zkstream_tpu'),
+            ('zk_uptime_ms',
+             int((time.monotonic() - self._started_at) * 1000)),
             ('zk_server_state', self.mode()),
             ('zk_member_role', self.role),
             ('zk_epoch', self.current_epoch()),
@@ -1502,7 +1558,8 @@ class ZKServer:
              'asyncio' if self.ingress is None
              else self.ingress.backend),
         ] + self._ingress_census_rows() + multi_rows + gate_rows \
-            + quorum_rows + config_rows + tick_rows + wal_rows
+            + quorum_rows + config_rows + tick_rows + blackbox_rows \
+            + wal_rows
 
     def _ingress_census_rows(self) -> list[tuple[str, object]]:
         """Per-shard connection census (sharded ingress only): how
@@ -1621,6 +1678,12 @@ class ZKEnsemble:
         self._transport = transport
         self._ingress_shards = ingress_shards
         self._collector = collector
+        #: Black-box co-tenancy: every member (followers and
+        #: observers included — they carry no WAL of their own) gets
+        #: a per-member flight-recorder ring in the ensemble's one
+        #: wal_dir; distinct member ids keep the files apart.
+        self._blackbox_dir = wal_dir if (wal_dir and self.db.wal
+                                         is not None) else None
         #: Quorum-commit: the ack barrier's membership is the VOTERS
         #: alone — attaching observers must not widen (or shrink) the
         #: majority a write waits for.
@@ -1636,7 +1699,8 @@ class ZKEnsemble:
                                                             lag=lag),
                      watchtable=watchtable, member=str(i),
                      transport=transport,
-                     ingress_shards=ingress_shards)
+                     ingress_shards=ingress_shards,
+                     blackbox_dir=self._blackbox_dir)
             for i in range(count + self.observer_count)]
         for s in self.servers[count:]:
             # an observer owns its own replica, watch table and
@@ -1803,7 +1867,8 @@ class ZKEnsemble:
                      store=ReplicaStore(self.db, lag=self._lag),
                      watchtable=self._watchtable, member=str(idx),
                      transport=self._transport,
-                     ingress_shards=self._ingress_shards)
+                     ingress_shards=self._ingress_shards,
+                     blackbox_dir=self._blackbox_dir)
         if self.quorum.enabled:
             s.quorum = self.quorum
         if self.election is not None:

@@ -73,7 +73,7 @@ class Span:
     __slots__ = ('span_id', 'kind', 'op', 'path', 'xid', 'zxid',
                  'backend', 'session_id', 'status', 'error',
                  't_wall', '_t0', 'duration_ms',
-                 'member', 'batch', 'nbytes', 'detail')
+                 'member', 'batch', 'nbytes', 'detail', '_on_slow')
 
     def __init__(self, span_id: int, op: str, path: str | None = None,
                  kind: str = 'op'):
@@ -99,6 +99,9 @@ class Span:
         self.t_wall = time.time()
         self._t0 = time.monotonic()
         self.duration_ms: float | None = None
+        #: Armed by a ring with a slow-op threshold: called once with
+        #: the span when finish() measures a duration at/over it.
+        self._on_slow = None
 
     def finish(self, zxid: int | None = None, status: str = 'ok',
                error: str | None = None) -> None:
@@ -111,6 +114,10 @@ class Span:
             self.zxid = zxid
         self.status = status
         self.error = error
+        hook = self._on_slow
+        if hook is not None:
+            self._on_slow = None
+            hook(self)
 
     def to_dict(self) -> dict:
         """JSON-ready dict, keys in one fixed order (insertion order
@@ -146,6 +153,12 @@ class TraceRing:
         #: ring overwrites since construction (the mntr
         #: ``zk_trace_ring_dropped`` row)
         self.dropped = 0
+        #: Slow-op digest threshold in ms, or None (off).  When set,
+        #: every span settled on this ring whose duration meets it is
+        #: handed to :attr:`on_slow` — the black-box plane's hook
+        #: (utils/blackbox.py persists the span's causal chain).
+        self.slow_ms: float | None = None
+        self.on_slow = None
         self._ring: collections.deque[Span] = collections.deque(
             maxlen=capacity)
         self._ids = itertools.count(1)
@@ -153,11 +166,21 @@ class TraceRing:
     def __len__(self) -> int:
         return len(self._ring)
 
+    def _slow_settled(self, span: Span) -> None:
+        """Span.finish() callback: apply the threshold (the hook fires
+        on every settle; sub-threshold spans stop here)."""
+        if (self.slow_ms is not None and self.on_slow is not None
+                and span.duration_ms is not None
+                and span.duration_ms >= self.slow_ms):
+            self.on_slow(span)
+
     def start(self, op: str, path: str | None = None,
               kind: str = 'op') -> Span:
         span = Span(next(self._ids), op, path, kind=kind)
         if self.member is not None:
             span.member = self.member
+        if self.slow_ms is not None:
+            span._on_slow = self._slow_settled
         if len(self._ring) >= self.capacity:
             self.dropped += 1       # the append below evicts one
         self._ring.append(span)
@@ -194,11 +217,15 @@ class TraceRing:
         span.t_wall = time.time()
         span._t0 = 0.0
         span.duration_ms = 0.0
+        span._on_slow = None        # already settled; checked below
         for name, val in fields.items():
             setattr(span, name, val)
         if len(self._ring) >= self.capacity:
             self.dropped += 1       # the append below evicts one
         self._ring.append(span)
+        if (self.slow_ms is not None
+                and span.duration_ms >= self.slow_ms):
+            self._slow_settled(span)
         return span
 
     def spans(self) -> list[Span]:
