@@ -8,10 +8,10 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check analyze native bench asan ubsan sanitize \
     chaos chaos-ensemble obs durability election linearize \
-    reconfig \
+    reconfig overload \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
-    bench-read bench-reconfig bench-blackbox \
+    bench-read bench-reconfig bench-blackbox bench-overload \
     timeline coverage clean
 
 all: check test
@@ -81,6 +81,19 @@ election:
 # chaos --tier ensemble --reconfig --seed N` (or --tier process).
 reconfig:
 	$(PYTHON) -m pytest tests/test_reconfig.py -q -m 'not slow'
+
+# Overload plane (io/overload.py; README "Overload plane"): admission
+# control, the inbound frame cap, rx/tx backpressure, slow-consumer
+# eviction, the global THROTTLED write bounce — units + e2e + the
+# tier-1 chaos slices with forced overload bursts, then the full
+# 120-schedule acceptance campaign (the slow marker).  Rerun a
+# failing campaign seed with `python -m zkstream_tpu chaos --tier
+# ensemble --overload --seed N`; scale with
+# ZKSTREAM_OVERLOAD_SCHEDULES / ZKSTREAM_CHAOS_SEED.
+overload:
+	$(PYTHON) -m pytest tests/test_overload.py -q -m 'not slow'
+	$(PYTHON) -m pytest tests/test_overload.py -q -m slow \
+	    -k overload_campaign
 
 # Failover-time envelope: paired leader-kill cells at 3- vs 5-member
 # in-process ensembles — kill the leader, time detection -> elected
@@ -165,6 +178,17 @@ bench-fanout:
 # Table in PROFILE.md "Read plane".
 bench-read:
 	$(PYTHON) bench.py --read
+
+# Overload-plane envelope (README "Overload plane"): paired
+# stalled-consumer defense cells (defense on vs overload=False — the
+# on-arm's peak tx backlog must stay bounded by the hard watermark
+# while the off-arm's grows with the pipelined reads) plus paired
+# plane-overhead cells (plane on vs ZKSTREAM_NO_OVERLOAD=1, fleet
+# 16/64, write-heavy) with exact two-sided sign tests.  Rounds via
+# ZKSTREAM_BENCH_OVERLOAD_ROUNDS.  Table in PROFILE.md "Overload
+# plane".
+bench-overload:
+	$(PYTHON) bench.py --overload
 
 # Observability suite: metrics (counters/gauges/histograms +
 # exposition), causal tracing (client spans + member rings + the

@@ -1746,6 +1746,244 @@ def bench_blackbox_overhead() -> None:
         }), flush=True)
 
 
+#: `bench.py --overload` fleet sizes for the plane-overhead family
+#: (the acceptance envelope: the overload plane's accounting must not
+#: be significantly slower than ``ZKSTREAM_NO_OVERLOAD=1``).
+OVERLOAD_SCALES = (16, 64)
+#: Stalled pipelining readers per defense cell, and the reads each
+#: one aims at the member's tx account (32 KiB replies apiece).
+OVERLOAD_STALLED = 3
+OVERLOAD_STALLED_READS = 60
+
+
+async def _overload_defense_round(defense: bool) -> dict:
+    """One stalled-consumer defense cell: a writer fans out sets to a
+    healthy watcher while OVERLOAD_STALLED subscribers stop reading
+    and pipeline fat gets — the wedged-socket reply backlog the hard
+    watermark exists for.  Returns the writer's set throughput, the
+    healthy watcher's observed fires, the peak per-connection tx
+    backlog the member carried, and the defense counters (zero on the
+    no-defense arm, where the backlog is the point of the row)."""
+    import asyncio
+    import time as _time
+
+    from zkstream_tpu import Client
+    from zkstream_tpu.io.backoff import BackoffPolicy
+    from zkstream_tpu.io.overload import OverloadConfig
+    from zkstream_tpu.server import ZKServer
+
+    fast = dict(
+        connect_policy=BackoffPolicy(timeout=300, retries=2, delay=30,
+                                     cap=200),
+        default_policy=BackoffPolicy(timeout=500, retries=3, delay=20,
+                                     cap=120))
+    if defense:
+        srv = await ZKServer(overload_config=OverloadConfig(
+            tx_soft=8 * 1024, tx_hard=64 * 1024)).start()
+    else:
+        srv = await ZKServer(overload=False).start()
+    cls = [Client(address='127.0.0.1', port=srv.port, **fast)
+           for _ in range(2 + OVERLOAD_STALLED)]
+    writer, healthy, stalled = cls[0], cls[1], cls[2:]
+    pending: list = []
+    try:
+        for c in cls:
+            c.start()
+            await c.wait_connected(timeout=5)
+        await writer.create('/fan', b'f')
+        await writer.create('/big', b'p' * (32 * 1024))
+        fires: list = []
+        healthy.watcher('/fan').on(
+            'dataChanged', lambda data, stat: fires.append(1))
+        while not fires:
+            await asyncio.sleep(0.005)
+        import socket as socketmod
+        for c in stalled:
+            tr = c.current_connection().transport
+            sock = tr.get_extra_info('socket')
+            if sock is not None:
+                # shrink the stalled reader's receive window so the
+                # kernel can't mask the backlog — the member's own tx
+                # account is what the cell measures
+                sock.setsockopt(socketmod.SOL_SOCKET,
+                                socketmod.SO_RCVBUF, 4096)
+            tr.pause_reading()
+            pending.extend(asyncio.ensure_future(c.get('/big'))
+                           for _ in range(OVERLOAD_STALLED_READS))
+        await asyncio.sleep(0)
+        # a tight background sampler: the cork drains at tick
+        # boundaries, so only a between-callbacks probe sees the real
+        # backlog crest (post-await samples always land after flush)
+        peak = [0]
+
+        async def _sample() -> None:
+            while True:
+                peak[0] = max(peak[0], max(
+                    (c._tx.buffered_bytes() for c in srv.conns
+                     if not c.closed), default=0))
+                await asyncio.sleep(0)
+        sampler = asyncio.ensure_future(_sample())
+        t0 = _time.perf_counter()
+        for _ in range(100):
+            await writer.set('/fan', b'f', version=-1)
+        dt = _time.perf_counter() - t0
+        sampler.cancel()
+        await asyncio.gather(sampler, return_exceptions=True)
+        ov = srv.overload
+        return {
+            'defense': defense,
+            'set_ops_per_sec': round(100 / dt, 1),
+            'healthy_fires': len(fires),
+            'peak_tx_buffered': peak[0],
+            'evictions': ov.evictions if ov is not None else 0,
+            'notifications_dropped':
+                ov.notifications_dropped if ov is not None else 0,
+        }
+    finally:
+        for t in pending:
+            t.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        for c in cls:
+            try:
+                await asyncio.wait_for(c.close(), 5)
+            except Exception:
+                pass
+        await srv.stop()
+
+
+def bench_overload() -> None:
+    """The overload plane's cost + defense envelope (`make
+    bench-overload`), two paired families:
+
+    - **defense cells** — the stalled-consumer scenario above,
+      defense on vs ``overload=False``: the on-arm's peak tx backlog
+      must stay bounded by the hard watermark while the off-arm's
+      grows with the pipelined reads, and the writer's fan-out
+      throughput must not be significantly SLOWER with the defense
+      (sign of the per-round set-ops/s delta, exact two-sided test);
+    - **overhead cells** — healthy write-heavy client-ops runs,
+      plane on vs ``ZKSTREAM_NO_OVERLOAD=1`` at fleet 16/64 with the
+      arm order alternating per round (the first-slot penalty
+      rationale in bench_trace_overhead): the plane's per-op
+      accounting must not be significantly slower.
+
+    Rounds via ZKSTREAM_BENCH_OVERLOAD_ROUNDS; the measured tables
+    live in PROFILE.md "Overload plane"."""
+    import asyncio as _aio
+
+    from zkstream_tpu.utils import native
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_OVERLOAD_ROUNDS',
+                                '8'))
+    drows: list = []
+    dcells: dict = {}
+    for rnd in range(rounds):
+        arms = ((True, False) if rnd % 2 == 0 else (False, True))
+        pair: dict = {}
+        for defense in arms:
+            try:
+                pair[defense] = _aio.run(
+                    _overload_defense_round(defense))
+            except Exception as e:
+                print('# overload defense cell %s round failed: %r'
+                      % ('on' if defense else 'off', e),
+                      file=sys.stderr)
+        for defense, r in pair.items():
+            key = 'on' if defense else 'off'
+            if key not in dcells or r['set_ops_per_sec'] > \
+                    dcells[key]['set_ops_per_sec']:
+                dcells[key] = r
+        if len(pair) == 2:
+            drows.append((pair[True]['set_ops_per_sec'],
+                          pair[False]['set_ops_per_sec'],
+                          pair[True]['peak_tx_buffered'],
+                          pair[False]['peak_tx_buffered']))
+    for key in sorted(dcells):
+        print('# overload_defense_cell %s' % json.dumps(dcells[key]),
+              file=sys.stderr)
+    if drows:
+        deltas = [(a - b) / b * 100.0 for a, b, _, _ in drows if b]
+        wins = sum(1 for a, b, _, _ in drows if a > b)
+        losses = sum(1 for a, b, _, _ in drows if a < b)
+        print(json.dumps({
+            'metric': 'overload_defense_sign_test',
+            'pair': 'defense-vs-off',
+            'stalled': OVERLOAD_STALLED,
+            'rounds': len(drows),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+            'peak_tx_on': max(p for _, _, p, _ in drows),
+            'peak_tx_off': max(p for _, _, _, p in drows),
+        }), flush=True)
+    mode = 'native' if native.ensure_lib() is not None else 'python'
+    # both arm states forced explicitly, ambient value restored — an
+    # inherited ZKSTREAM_NO_OVERLOAD=1 would silently turn the
+    # defended arm into a second undefended one
+    ambient = os.environ.get('ZKSTREAM_NO_OVERLOAD')
+    rows: dict = {}
+    cells: dict = {}
+    try:
+        for rnd in range(rounds):
+            arms = (('overload', 'nooverload') if rnd % 2 == 0
+                    else ('nooverload', 'overload'))
+            for n in OVERLOAD_SCALES:
+                pair = {}
+                for arm in arms:
+                    if arm == 'nooverload':
+                        os.environ['ZKSTREAM_NO_OVERLOAD'] = '1'
+                    else:
+                        os.environ.pop('ZKSTREAM_NO_OVERLOAD', None)
+                    try:
+                        r = _aio.run(_client_ops_run(
+                            mode, n, write_heavy=True))
+                    except Exception as e:
+                        print('# overload cell %s@%d round failed: '
+                              '%r' % (arm, n, e), file=sys.stderr)
+                        continue
+                    r['overload_arm'] = arm
+                    pair[arm] = r
+                for arm, r in pair.items():
+                    key = (n, arm)
+                    if len(pair) == 2:
+                        rows.setdefault(key, []).append(
+                            r['set']['ops_per_sec'])
+                    if key not in cells or r['set']['ops_per_sec'] \
+                            > cells[key]['set']['ops_per_sec']:
+                        cells[key] = r
+    finally:
+        if ambient is None:
+            os.environ.pop('ZKSTREAM_NO_OVERLOAD', None)
+        else:
+            os.environ['ZKSTREAM_NO_OVERLOAD'] = ambient
+    for key in sorted(cells, key=str):
+        print('# overload_cell %s' % json.dumps(cells[key]),
+              file=sys.stderr)
+    for n in OVERLOAD_SCALES:
+        a = rows.get((n, 'overload'), [])
+        b = rows.get((n, 'nooverload'), [])
+        if not a or not b:
+            continue
+        paired = list(zip(a, b))
+        deltas = [(x - y) / y * 100.0 for x, y in paired if y]
+        wins = sum(1 for x, y in paired if x > y)
+        losses = sum(1 for x, y in paired if x < y)
+        print(json.dumps({
+            'metric': 'overload_plane_sign_test',
+            'pair': 'overload-vs-off',
+            'conns': n,
+            'rounds': len(paired),
+            'wins': wins,
+            'losses': losses,
+            'mean_delta_pct': round(sum(deltas)
+                                    / max(1, len(deltas)), 1),
+            'sign_p': round(sign_test_p(wins, losses), 4),
+        }), flush=True)
+
+
 #: `bench.py --fanout` sweep (the serving-plane cell family): sessions
 #: on the box x watchers on the hot path.  -1 = every session watches.
 FANOUT_SESSIONS = (1000, 10000, 100000)
@@ -2881,6 +3119,14 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_blackbox_overhead()
+        return
+    if '--overload' in sys.argv:
+        # `make bench-overload`: the overload plane's cost + defense
+        # family (stalled-consumer defense cells + plane-overhead
+        # cells vs ZKSTREAM_NO_OVERLOAD=1).  Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_overload()
         return
     if '--transport' in sys.argv:
         # `make bench-transport`: the batched-syscall transport-tier

@@ -16,3 +16,21 @@ class Plane:
 
     def tick_server(self):
         self.ticks.increment({'plane': 'server'})
+
+
+class OverloadPlaneFixture:
+    """The overload-plane idiom (io/overload.py): a watermark knob
+    read from the environment plus a histogram sampled at watermark
+    checks — both documented in ``corpus_readme.md``, so the drift
+    checker stays quiet."""
+
+    def __init__(self, collector):
+        self.tx_soft = int(
+            os.environ.get('ZKSTREAM_CORPUS_TX_SOFT') or '1024')
+        self.tx_hist = collector.histogram(
+            'zkstream_corpus_tx_bytes', 'documented',
+            buckets=(1024, 65536))
+
+    def check(self, buffered):
+        self.tx_hist.observe(buffered)
+        return buffered >= self.tx_soft

@@ -49,11 +49,16 @@ def test_negative_length_rejected():
 
 
 def test_oversized_length_rejected():
+    # over-cap declarations raise the TYPED cap error (overload
+    # plane): still a ZKProtocolError, but carrying length + cap so
+    # the evicting side can trace what the peer declared
     d = FrameDecoder()
     too_big = (MAX_PACKET + 1).to_bytes(4, 'big')
     with pytest.raises(ZKProtocolError) as ei:
         d.feed(too_big)
-    assert ei.value.code == 'BAD_LENGTH'
+    assert ei.value.code == 'FRAME_TOO_LARGE'
+    assert ei.value.length == MAX_PACKET + 1
+    assert ei.value.cap == MAX_PACKET
 
 
 def test_max_packet_boundary_accepted():

@@ -63,11 +63,14 @@ def test_native_bad_length_contract():
 
 
 def test_native_oversize_length():
+    # the native fast path defers the length-cap check to the Python
+    # wrapper, which raises the typed cap error (overload plane)
     blob = struct.pack('>i', MAX_PACKET + 1) + b'\0' * 16
     dec = FrameDecoder(use_native=True)
     with pytest.raises(ZKProtocolError) as ei:
         dec.feed(blob)
-    assert ei.value.code == 'BAD_LENGTH'
+    assert ei.value.code == 'FRAME_TOO_LARGE'
+    assert ei.value.length == MAX_PACKET + 1
 
 
 def test_native_partial_then_complete():

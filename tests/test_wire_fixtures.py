@@ -471,6 +471,9 @@ ERROR_REPLY_FIXTURES = [
     # this stack's own code (server/election.py): a deposed member's
     # write, definitively rejected at a stale leadership epoch
     ('CREATE', b'\xff\xff\xff\x7e', 'EPOCH_FENCED'),           # -130
+    # this stack's own code (io/overload.py): a write bounced at the
+    # global memory watermark — definitively NOT applied, retryable
+    ('SET_DATA', b'\xff\xff\xff\x7d', 'THROTTLED'),            # -131
     ('CREATE', b'\xff\xff\xff\x94',
      'NO_CHILDREN_FOR_EPHEMERALS'),                            # -108
     ('DELETE', b'\xff\xff\xff\x91', 'NOT_EMPTY'),              # -111

@@ -463,6 +463,17 @@ def build_parser() -> argparse.ArgumentParser:
                          'under test.  Part of the rerun key like '
                          '--clients.  Default: drawn per seed '
                          '(ensemble tier) / 0 (process tier)')
+    ch.add_argument('--overload', action='store_true',
+                    help='force overload bursts into every schedule '
+                         '(README "Overload plane"): the ensemble/'
+                         'concurrent tiers draw forced pressure '
+                         'steps — raw connection floods against the '
+                         'admission caps + pacer, stalled client '
+                         'readers (slow-consumer defense), and '
+                         'oversized declared frames the member must '
+                         'refuse with a definite close.  Part of '
+                         'the rerun key like --clients.  Default: '
+                         'drawn per seed')
     ch.add_argument('--reconfig', action='store_true',
                     help='force membership reconfigurations into '
                          'every schedule (README "Dynamic '
@@ -699,7 +710,11 @@ async def _chaos(args) -> int:
             # --reconfig forces two steps per schedule; the FIRST
             # executed step is always a voter replace (io/faults.py),
             # so every campaign holds >= 1 joint-majority handoff
-            reconfigs=2 if getattr(args, 'reconfig', False) else None)
+            reconfigs=2 if getattr(args, 'reconfig', False) else None,
+            # --overload likewise forces two pressure bursts per
+            # schedule (flood / stalled reader / oversized frame)
+            overloads=2 if getattr(args, 'overload', False)
+            else None)
     elif args.tier == 'process':
         if getattr(args, 'no_election', False):
             # the process tier IS the election plane: there is no
@@ -707,6 +722,11 @@ async def _chaos(args) -> int:
             print('error: --no-election has no meaning on the '
                   'process tier (symmetric peers have no static '
                   'leader); use --tier ensemble', file=sys.stderr)
+            return 2
+        if getattr(args, 'overload', False):
+            print('error: --overload runs on the in-process '
+                  'ensemble tier; use --tier ensemble',
+                  file=sys.stderr)
             return 2
         from .server.election import run_process_campaign
         results = await run_process_campaign(
@@ -732,6 +752,11 @@ async def _chaos(args) -> int:
             print('error: --reconfig needs an ensemble; use '
                   '--tier ensemble or --tier process',
                   file=sys.stderr)
+            return 2
+        if getattr(args, 'overload', False):
+            print('error: --overload needs an ensemble; use '
+                  '--tier ensemble (the transport tier draws its '
+                  'own overload slice per seed)', file=sys.stderr)
             return 2
         results = await run_campaign(
             args.seed, args.schedules,
@@ -768,7 +793,7 @@ async def _chaos(args) -> int:
         clients = getattr(args, 'clients', None)
         observers = getattr(args, 'observers', None)
         print('failing seeds (rerun: python -m zkstream_tpu chaos '
-              '--tier %s%s%s%s --seed N --schedules 1): %s'
+              '--tier %s%s%s%s%s --seed N --schedules 1): %s'
               % (args.tier,
                  ' --clients %d' % (clients,)
                  if clients and clients > 1 else '',
@@ -776,6 +801,8 @@ async def _chaos(args) -> int:
                  if observers else '',
                  ' --reconfig'
                  if getattr(args, 'reconfig', False) else '',
+                 ' --overload'
+                 if getattr(args, 'overload', False) else '',
                  ', '.join(str(r.seed) for r in bad)),
               file=sys.stderr)
         return 1

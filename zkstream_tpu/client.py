@@ -40,8 +40,10 @@ from .io.pool import (
 )
 from .io.session import ZKSession
 from .io.watcher import ZKWatcher
-from .protocol.consts import CreateFlag
-from .protocol.errors import ZKDeadlineError, ZKNotConnectedError
+from .io.overload import overload_enabled
+from .protocol.consts import MAX_PACKET, CreateFlag
+from .protocol.errors import ZKDeadlineError, ZKNotConnectedError, \
+    ZKThrottledError
 from .protocol.records import OPEN_ACL_UNSAFE, Stat
 from .utils.aio import ambient_loop
 from .utils.fsm import FSM, bind_transition_metrics
@@ -91,7 +93,8 @@ class Client(FSM):
                  flush_cap: int | None = None,
                  read_distribution: bool | None = None,
                  read_subset: int | None = None,
-                 resolver: Resolver | None = None):
+                 resolver: Resolver | None = None,
+                 max_frame: int | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -122,6 +125,15 @@ class Client(FSM):
         #: None = auto (native if built), True = force C++, False =
         #: force pure Python (benchmarks, A/B tests).
         self.use_native_codec = use_native_codec
+        #: Inbound frame cap for this client's connections (README
+        #: "Overload plane"): a reply whose length prefix exceeds it
+        #: raises :class:`ZKFrameTooLargeError` before any buffering.
+        #: None = env resolution (``ZKSTREAM_MAX_FRAME`` / the wire
+        #: default); with ``ZKSTREAM_NO_OVERLOAD=1`` the cap pins to
+        #: the legacy MAX_PACKET so byte streams stay bit-identical.
+        self.max_frame = (max_frame if max_frame is not None
+                          else (None if overload_enabled()
+                                else MAX_PACKET))
         #: Outbound write coalescing for this client's connections
         #: (io/sendplane.py): None = process default (on unless
         #: ZKSTREAM_NO_CORK=1), True/False force a path (benchmarks,
@@ -582,6 +594,40 @@ class Client(FSM):
         fut, span = self._start_op(conn, pkt)
         return await self._await_op(fut, opcode, path, deadline, span)
 
+    async def _write_op(self, pkt: dict, opcode: str,
+                        path: str | None, deadline) -> dict:
+        """One write on the primary connection, retrying THROTTLED
+        bounces (README "Overload plane").
+
+        An overloaded member bounces new writes with a typed
+        :class:`ZKThrottledError` BEFORE proposing them — the write
+        provably did not happen, so a blind resend is safe (no
+        at-most-once concern, unlike a timeout).  The retry backs off
+        on the client's default policy (capped exponential, full
+        jitter) and gives up with the last THROTTLED error once the
+        policy's attempt budget is spent.  Each attempt re-resolves
+        the connection and sends a FRESH packet dict — ``_start_op``
+        stamps the xid into it, and a retried xid would collide in
+        the pending table."""
+        backoff = None
+        while True:
+            conn = self._conn_or_raise()
+            fut, span = self._start_op(conn, dict(pkt))
+            try:
+                return await self._await_op(fut, opcode, path,
+                                            deadline, span)
+            except ZKThrottledError:
+                if backoff is None:
+                    backoff = self._retry_policy.backoff(
+                        seed=self._seed)
+                if backoff.attempt >= self._retry_policy.retries:
+                    raise
+                delay_ms = backoff.next_delay()
+                self.log.debug('THROTTLED %s %s; retry %d in %dms',
+                               opcode, path, backoff.attempt,
+                               delay_ms)
+                await asyncio.sleep(delay_ms / 1000.0)
+
     def _note_read_floor(self, zxid: int) -> None:
         """A distributed read showed the client member state at
         ``zxid``: raise the client floor AND the session's gate
@@ -710,12 +756,10 @@ class Client(FSM):
         self._check_data(data)
         if acl is None:
             acl = list(OPEN_ACL_UNSAFE)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'CREATE',
-                                          'path': path, 'data': data,
-                                          'acl': acl,
-                                          'flags': CreateFlag(flags)})
-        pkt = await self._await_op(fut, 'CREATE', path, deadline, span)
+        pkt = await self._write_op({'opcode': 'CREATE', 'path': path,
+                                    'data': data, 'acl': acl,
+                                    'flags': CreateFlag(flags)},
+                                   'CREATE', path, deadline)
         return pkt['path']
 
     async def create_with_empty_parents(self, path: str, data: bytes,
@@ -756,23 +800,19 @@ class Client(FSM):
         self._check_path(path)
         self._check_data(data)
         self._check_version(version)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'SET_DATA',
-                                          'path': path, 'data': data,
-                                          'version': version})
-        pkt = await self._await_op(fut, 'SET_DATA', path, deadline,
-                                   span)
+        pkt = await self._write_op({'opcode': 'SET_DATA',
+                                    'path': path, 'data': data,
+                                    'version': version},
+                                   'SET_DATA', path, deadline)
         return pkt['stat']
 
     async def delete(self, path: str, version: int,
                      deadline=_USE_DEFAULT) -> None:
         self._check_path(path)
         self._check_version(version)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'DELETE',
-                                          'path': path,
-                                          'version': version})
-        await self._await_op(fut, 'DELETE', path, deadline, span)
+        await self._write_op({'opcode': 'DELETE', 'path': path,
+                              'version': version},
+                             'DELETE', path, deadline)
 
     async def stat(self, path: str, deadline=_USE_DEFAULT) -> Stat:
         self._check_path(path)
@@ -842,10 +882,9 @@ class Client(FSM):
                 self._check_version(op.get('version', -1))
                 sub['version'] = op.get('version', -1)
             wire_ops.append(sub)
-        conn = self._conn_or_raise()
-        fut, span = self._start_op(conn, {'opcode': 'MULTI',
-                                          'ops': wire_ops})
-        pkt = await self._await_op(fut, 'MULTI', None, deadline, span)
+        pkt = await self._write_op({'opcode': 'MULTI',
+                                    'ops': wire_ops},
+                                   'MULTI', None, deadline)
         results = pkt['results']
         if any(r['op'] == 'error' for r in results):
             raise ZKMultiError(results)

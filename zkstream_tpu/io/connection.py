@@ -22,7 +22,8 @@ import time
 from typing import Callable
 
 from ..protocol import consts
-from ..protocol.errors import ZKError, ZKPingTimeoutError, ZKProtocolError
+from ..protocol.errors import ZKError, ZKPingTimeoutError, \
+    ZKProtocolError, ZKThrottledError
 from ..protocol.framing import PacketCodec
 from ..utils.aio import set_nodelay
 from ..utils.events import EventEmitter
@@ -209,7 +210,8 @@ class ZKConnection(FSM):
 
     def state_connecting(self, S) -> None:
         self.codec = PacketCodec(
-            use_native=getattr(self.client, 'use_native_codec', None))
+            use_native=getattr(self.client, 'use_native_codec', None),
+            max_frame=getattr(self.client, 'max_frame', None))
         self.log.debug('attempting new connection')
         self._connect_t0 = time.monotonic()
 
@@ -597,7 +599,13 @@ class ZKConnection(FSM):
         else:
             _finish_span(req, zxid=pkt.get('zxid'), status='error',
                          error=pkt['err'])
-            req.emit('error', ZKError(pkt['err']), pkt)
+            # the overloaded-member bounce gets its typed class so
+            # the client's write path can key its backoff+retry on
+            # isinstance instead of string-matching the code
+            err = (ZKThrottledError()
+                   if pkt['err'] == 'THROTTLED'
+                   else ZKError(pkt['err']))
+            req.emit('error', err, pkt)
 
     def request(self, pkt: dict) -> ZKRequest:
         """Send a normal (positive-xid) request

@@ -59,8 +59,12 @@ from .invariants import AMBIGUOUS_CODES
 #: batched drain's tick-time faults; 'disk' drives the durability
 #: plane (fsync latency/errors, crash-before-fsync vs crash-after-
 #: fsync windows — server/persist.py).
+#: 'overload' drives the overload plane's pressure bursts (raw
+#: connection floods, stalled readers, oversized declared frames —
+#: io/overload.py).
 CATEGORIES = ('connect', 'rx', 'tx', 'accept', 'server_tx',
-              'partition', 'plan', 'ingest', 'disk', 'server_rx')
+              'partition', 'plan', 'ingest', 'disk', 'server_rx',
+              'overload')
 
 
 class InjectedRefusal(ConnectionRefusedError):
@@ -111,6 +115,16 @@ class FaultConfig:
     p_fsync_delay: float = 0.0
     fsync_delay_ms: tuple[float, float] = (0.2, 5.0)
     p_fsync_error: float = 0.0
+    # overload plane (io/overload.py): plan-level pressure bursts —
+    # raw connection floods against the admission path, stalled
+    # client readers (slow consumers growing the member's tx
+    # backlog), and oversized declared frame lengths (the frame cap
+    # must refuse BEFORE buffering)
+    p_conn_flood: float = 0.0
+    flood_conns: int = 12
+    p_stall_reader: float = 0.0
+    stall_window_ms: tuple[float, float] = (20.0, 120.0)
+    p_oversize_frame: float = 0.0
     #: stop firing after this many injected faults (None = unbounded);
     #: the budget is what makes randomized campaigns converge
     max_faults: int | None = 8
@@ -152,6 +166,17 @@ class FaultConfig:
             cfg.server_rx_delay_ms = (0.1, rrng.uniform(0.5, 6.0))
         if rrng.random() < 0.1:
             cfg.p_server_rx_reset = rrng.uniform(0.01, 0.08)
+        # overload faults likewise ride their own stream (PR 18):
+        # the transport mixes existing seeds pin stay untouched
+        ovrng = random.Random('cfg-overload/%d' % (seed,))
+        if ovrng.random() < 0.3:
+            cfg.p_conn_flood = ovrng.uniform(0.1, 0.5)
+            cfg.flood_conns = ovrng.randint(6, 24)
+        if ovrng.random() < 0.3:
+            cfg.p_stall_reader = ovrng.uniform(0.1, 0.5)
+            cfg.stall_window_ms = (10.0, ovrng.uniform(40.0, 150.0))
+        if ovrng.random() < 0.2:
+            cfg.p_oversize_frame = ovrng.uniform(0.1, 0.4)
         return cfg
 
     @classmethod
@@ -564,6 +589,25 @@ class FaultInjector:
                          'fsync error')
         return delay, err
 
+    def overload_action(self) -> str | None:
+        """One per-step overload decision ('overload' stream,
+        fault-budget accounted): 'stall' (park a client reader —
+        the slow-consumer shape), 'flood' (raw connection burst
+        against the admission path), 'oversize' (an absurd declared
+        frame length), or None.  The campaign drivers map each to
+        the matching pressure action (io/faults.py force_overload)."""
+        cfg = self.config
+        if self._take('overload', cfg.p_stall_reader,
+                      'stalled client reader'):
+            return 'stall'
+        if self._take('overload', cfg.p_conn_flood,
+                      'raw connection flood'):
+            return 'flood'
+        if self._take('overload', cfg.p_oversize_frame,
+                      'oversized declared frame'):
+            return 'oversize'
+        return None
+
     def crash_window_before_fsync(self) -> bool:
         """The campaign's SIGKILL placement relative to the pending
         fsync: True = die before it completes (the open segment's
@@ -643,9 +687,10 @@ def record_settle_error(res: 'ScheduleResult', h, call_id: int,
         h.settle(call_id, 'error', error='MULTI_REJECTED')
     elif code in SPEC_ERRORS:
         h.settle(call_id, 'error', error=code)
-    elif code == 'EPOCH_FENCED':
-        # a typed fencing bounce: neither acked nor silently applied
-        # (README "Failure semantics")
+    elif code in ('EPOCH_FENCED', 'THROTTLED'):
+        # typed bounces that provably never applied: the epoch
+        # fence, and the overloaded member's write throttle (README
+        # "Overload plane" — the bounce happens BEFORE proposing)
         h.settle(call_id, 'fail', error=code)
     else:
         h.settle(call_id, 'unknown', error=code)
@@ -781,6 +826,16 @@ async def run_schedule(seed: int, ops: int = 6,
     last_acked_set = -1                # newest acked /w value index
     fires: list[int] = []              # dataChanged mzxids
 
+    # overload slice (README "Overload plane"), its own fresh stream
+    # so existing transport seeds stay pinned: ~1 in 4 schedules
+    # fires one mid-schedule pressure burst — a raw connection flood
+    # against the admission path, or an oversized declared frame the
+    # member must refuse with a definite close
+    ovrng = random.Random('churn-overload/%d' % (seed,))
+    overload_burst = (ovrng.choice(('none', 'none', 'none', 'flood',
+                                    'flood', 'oversize'))
+                      if ovrng.random() < 0.4 else 'none')
+
     async def bounded(coro, what):
         """Run one op under the shared hard bound (_bounded_op)."""
         return await _bounded_op(res, coro, what)
@@ -814,6 +869,18 @@ async def run_schedule(seed: int, ops: int = 6,
                 except (asyncio.TimeoutError, TimeoutError):
                     pass
             res.ops += 1
+            if i == ops // 2 and overload_burst != 'none':
+                if overload_burst == 'flood':
+                    await _overload_flood('127.0.0.1', srv.port,
+                                          ovrng.randint(6, 16))
+                else:
+                    hung = await _overload_oversize('127.0.0.1',
+                                                    srv.port)
+                    if hung:
+                        res.violations.append(
+                            'oversized raw frame: no definite close '
+                            'within 2s (the frame cap must refuse '
+                            'before buffering)')
             kind = inj.choice('plan', ('set', 'create', 'delete',
                                        'get', 'list', 'sync'))
             if kind == 'set':
@@ -1052,6 +1119,14 @@ class FaultPlan:
     #: a subset-capped plane must rebalance correctly when the
     #: resolver adopts a post-reconfig member list
     read_subset: int | None = None
+    #: forced overload bursts (README "Overload plane"): evenly
+    #: spaced plan steps each fire one pressure action against a
+    #: live member — a raw connection flood (admission caps +
+    #: pacer), a stalled client reader (slow-consumer defense), or
+    #: an oversized declared frame (the frame cap).  The action mix
+    #: draws from a fresh 'churn-overload' stream; part of the
+    #: rerun key: ``chaos --overload N``.
+    overloads: int = 0
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -1087,6 +1162,11 @@ class FaultPlan:
         rrng = random.Random('plan-reconfig/%d' % (seed,))
         plan.reconfigs = rrng.choice([0, 0, 0, 1, 2])
         plan.read_subset = rrng.choice([None, None, 2, 3])
+        # and for the overload plane (PR 18): the burst count rides
+        # a fresh stream, so every draw existing seeds pinned still
+        # produces the same value
+        ovrng = random.Random('plan-overload/%d' % (seed,))
+        plan.overloads = ovrng.choice([0, 0, 0, 1, 2])
         return plan
 
     def forced_election_steps(self) -> set[int]:
@@ -1114,6 +1194,15 @@ class FaultPlan:
             return set()
         return {((k + 1) * self.ops) // (self.reconfigs + 1)
                 for k in range(self.reconfigs)}
+
+    def forced_overload_steps(self) -> set[int]:
+        """The plan steps that fire an overload burst (evenly
+        spaced, before the drawn action; offset from the reconfig
+        spacing so the two rarely collide)."""
+        if self.overloads <= 0:
+            return set()
+        return {((2 * k + 1) * self.ops) // (2 * self.overloads + 1)
+                for k in range(self.overloads)}
 
 
 class EnsembleUnderTest:
@@ -1368,13 +1457,129 @@ def _make_force_reconfig(ens, res, rrng, note_member,
     return force_reconfig
 
 
+#: The forced-overload action mix ('churn-overload' stream;
+#: repetition = weight).  Every action must observe a definite
+#: outcome — an oversized raw frame left hanging open is a
+#: violation, a shed flood connection is the defense working.
+OVERLOAD_ACTIONS = ('flood', 'flood', 'stall', 'stall', 'oversize')
+
+
+async def _overload_flood(address: str, port: int, n: int,
+                          hold_s: float = 0.05) -> None:
+    """Open ``n`` raw TCP connections at once and hold them briefly:
+    the admission path (per-shard/global caps + handshake pacer,
+    io/overload.py) must shed or accept every one with the member
+    still serving — never wedge the accept loop.  A refused or
+    RST-shed dial IS the defense working, so errors are swallowed."""
+    async def one():
+        try:
+            _r, w = await asyncio.wait_for(
+                asyncio.open_connection(address, port), 1.0)
+        except (OSError, asyncio.TimeoutError, TimeoutError):
+            return
+        try:
+            await asyncio.sleep(hold_s)
+        finally:
+            w.close()
+    await asyncio.gather(*(one() for _ in range(n)),
+                         return_exceptions=True)
+
+
+async def _overload_oversize(address: str, port: int,
+                             declared: int = 1 << 27) -> bool:
+    """Declare an absurd frame length on a raw socket: the member
+    must refuse it BEFORE buffering (a typed frame-cap eviction,
+    io/overload.py) and the socket must observe a definite close.
+    Returns True when the socket HUNG open instead — the caller
+    records that as a violation."""
+    try:
+        r, w = await asyncio.wait_for(
+            asyncio.open_connection(address, port), 1.0)
+    except (OSError, asyncio.TimeoutError, TimeoutError):
+        return False
+    try:
+        w.write(struct.pack('>i', declared) + b'\x00' * 16)
+        try:
+            await asyncio.wait_for(w.drain(), 1.0)
+        except (OSError, asyncio.TimeoutError, TimeoutError):
+            pass
+        try:
+            await asyncio.wait_for(r.read(1 << 16), 2.0)
+        except (asyncio.TimeoutError, TimeoutError):
+            return True
+        except OSError:
+            return False
+        return False
+    finally:
+        try:
+            w.close()
+        except OSError:
+            pass
+
+
+def _make_force_overload(res, ovrng, note_member, live_address,
+                         pick_client, cfg=None):
+    """Build the overload pressure step shared by the ensemble
+    schedules (single-client and concurrent): one burst per call
+    against a live member — a forced plan step draws its own action
+    (``act=None``), a config-probability firing passes the
+    injector's drawn action in.  ``live_address()`` returns a live
+    member's ``(host, port)`` or None; ``pick_client()`` returns
+    the client whose reader the 'stall' action parks (the
+    slow-consumer shape — the member's tx backlog for that session
+    grows until the soft watermark starts shedding notifications).
+    ``cfg`` (FaultConfig) bounds the flood size and stall window."""
+    async def force_overload(act: str | None = None) -> None:
+        addr = live_address()
+        if addr is None:
+            return
+        if act is None:
+            act = ovrng.choice(OVERLOAD_ACTIONS)
+        if act == 'flood':
+            n = (ovrng.randint(6, max(7, cfg.flood_conns))
+                 if cfg is not None else ovrng.randint(6, 20))
+            note_member('overload-flood(%d)' % (n,), '-')
+            await _overload_flood(addr[0], addr[1], n)
+        elif act == 'stall':
+            c = pick_client()
+            conn = (c.current_connection()
+                    if c is not None else None)
+            t = getattr(conn, 'transport', None)
+            if t is None:
+                return
+            lo, hi = (cfg.stall_window_ms if cfg is not None
+                      else (20.0, 120.0))
+            window = ovrng.uniform(lo, hi) / 1000.0
+            note_member('overload-stall(%.0fms)'
+                        % (window * 1e3), '-')
+            try:
+                t.pause_reading()
+            except (RuntimeError, OSError):
+                return
+            await asyncio.sleep(window)
+            try:
+                t.resume_reading()
+            except (RuntimeError, OSError):
+                pass
+        else:
+            note_member('overload-oversize', '-')
+            hung = await _overload_oversize(addr[0], addr[1])
+            if hung:
+                res.violations.append(
+                    'oversized raw frame: no definite close within '
+                    '2s (the frame cap must refuse before '
+                    'buffering)')
+    return force_overload
+
+
 async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 collector=None,
                                 plan: FaultPlan | None = None,
                                 elections: int | None = None,
                                 clients: int | None = None,
                                 observers: int | None = None,
-                                reconfigs: int | None = None
+                                reconfigs: int | None = None,
+                                overloads: int | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
     client workload, every op recorded into an append-only history,
@@ -1391,7 +1596,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         return await run_concurrent_schedule(
             seed, ops=ops, clients=clients, collector=collector,
             plan=plan, elections=elections, observers=observers,
-            reconfigs=reconfigs)
+            reconfigs=reconfigs, overloads=overloads)
     from ..client import Client
     from ..protocol.consts import CreateFlag
     from .backoff import BackoffPolicy
@@ -1411,12 +1616,17 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         plan.observers = observers
     if reconfigs is not None:
         plan.reconfigs = reconfigs
+    if overloads is not None:
+        plan.overloads = overloads
     #: observer churn draws ride their own stream (fresh per seed):
     #: attaching observers must not shift any draw existing seeds pin
     orng = random.Random('churn-obs/%d' % (seed,))
     #: forced-reconfig draws (victim/action choice) — fresh stream,
     #: same rule
     rrng = random.Random('churn-reconfig/%d' % (seed,))
+    #: forced-overload draws (action/size choice) — fresh stream,
+    #: same rule
+    ovrng = random.Random('churn-overload/%d' % (seed,))
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble')
     h = History()
@@ -1542,6 +1752,16 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         ens, res, rrng, note_member, force_election,
         lambda: client.update_backends(ens.config_addresses()))
 
+    def _live_address():
+        live = ens.live()
+        if not live:
+            return None
+        return ens.servers[live[0]].address
+
+    force_overload = _make_force_overload(
+        res, ovrng, note_member, _live_address, lambda: client,
+        cfg=plan.config)
+
     def sid() -> int:
         for r in reversed(h.records):
             if r['kind'] == 'op':
@@ -1641,6 +1861,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         forced_steps = plan.forced_election_steps()
         multi_steps = plan.forced_multi_steps()
         reconfig_steps = plan.forced_reconfig_steps()
+        overload_steps = plan.forced_overload_steps()
         for i in range(plan.ops):
             await wait_usable(1.5)
             res.ops += 1
@@ -1648,6 +1869,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                 await force_election()
             if i in reconfig_steps:
                 await force_reconfig()
+            if i in overload_steps:
+                await force_overload()
             if i in multi_steps:
                 await do_multi(i)
             act = inj.choice('plan', PLAN_ACTIONS)
@@ -1792,6 +2015,12 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                     else:
                         note_member('observer-heal', oidx)
                         ens.set_lag(oidx, 0.0)
+            # config-probability overload firings ('overload'
+            # stream, fault-budget accounted) on top of the plan's
+            # forced steps
+            ov_act = inj.overload_action()
+            if ov_act is not None:
+                await force_overload(ov_act)
 
         # -- verification: faults off, ensemble healed --------------
         inj.stop()
@@ -1944,20 +2173,23 @@ async def run_ensemble_campaign(base_seed: int, schedules: int,
                                 elections: int | None = None,
                                 clients: int | None = None,
                                 observers: int | None = None,
-                                reconfigs: int | None = None
+                                reconfigs: int | None = None,
+                                overloads: int | None = None
                                 ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
     starting at ``base_seed`` (``clients`` > 1: the concurrent
     tier, every schedule linearizability-checked; ``observers``
     overrides every plan's non-voting member count; ``reconfigs``
-    every plan's forced membership-change count)."""
+    every plan's forced membership-change count; ``overloads``
+    every plan's forced overload-burst count)."""
     out = []
     for i in range(schedules):
         r = await run_ensemble_schedule(base_seed + i, ops=ops,
                                         elections=elections,
                                         clients=clients,
                                         observers=observers,
-                                        reconfigs=reconfigs)
+                                        reconfigs=reconfigs,
+                                        overloads=overloads)
         out.append(r)
         if progress is not None:
             progress(r)
@@ -1998,7 +2230,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                                   plan: FaultPlan | None = None,
                                   elections: int | None = None,
                                   observers: int | None = None,
-                                  reconfigs: int | None = None
+                                  reconfigs: int | None = None,
+                                  overloads: int | None = None
                                   ) -> ScheduleResult:
     """One seeded concurrent schedule: ``clients`` Clients driven
     from per-client RNG streams drawn fresh from the FaultPlan, each
@@ -2035,6 +2268,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         plan.observers = observers
     if reconfigs is not None:
         plan.reconfigs = reconfigs
+    if overloads is not None:
+        plan.overloads = overloads
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble',
                          clients=clients)
@@ -2047,6 +2282,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     orng = random.Random('churn-obs/%d' % (seed,))
     #: forced-reconfig draws — fresh stream, same rule
     rrng = random.Random('churn-reconfig/%d' % (seed,))
+    #: forced-overload draws — fresh stream, same rule
+    ovrng = random.Random('churn-overload/%d' % (seed,))
 
     wal_dir = tempfile.mkdtemp(prefix='zkchaos-conc-wal-')
     crash_dir = tempfile.mkdtemp(prefix='zkchaos-conc-crash-')
@@ -2170,6 +2407,16 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     force_reconfig = _make_force_reconfig(
         ens, res, rrng, note_member, force_election,
         _update_resolvers)
+
+    def _live_address():
+        live = ens.live()
+        if not live:
+            return None
+        return ens.servers[live[0]].address
+
+    force_overload = _make_force_overload(
+        res, ovrng, note_member, _live_address,
+        lambda: cls[ovrng.randrange(len(cls))], cfg=plan.config)
 
     async def usable(c, timeout: float) -> bool:
         if c.is_connected():
@@ -2297,11 +2544,14 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
     async def churn() -> None:
         forced = plan.forced_election_steps()
         reconfig_steps = plan.forced_reconfig_steps()
+        overload_steps = plan.forced_overload_steps()
         for i in range(ops):
             if i in forced:
                 await force_election()
             if i in reconfig_steps:
                 await force_reconfig()
+            if i in overload_steps:
+                await force_overload()
             act = crng.choice(CONCURRENT_CHURN)
             if act == 'kill_any':
                 voter_set = set(ens.voter_idxs())
@@ -2360,6 +2610,11 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                     else:
                         note_member('observer-heal', oidx)
                         ens.set_lag(oidx, 0.0)
+            # config-probability overload firings ('overload'
+            # stream, fault-budget accounted)
+            ov_act = inj.overload_action()
+            if ov_act is not None:
+                await force_overload(ov_act)
             await asyncio.sleep(crng.uniform(0.005, 0.04))
 
     try:

@@ -514,14 +514,27 @@ class IngressPlane:
                 return          # listener closed under the callback
             if srv.faults is not None and srv.faults.accept_refuse():
                 # injected accept-loop refusal: RST, like the
-                # validator path's transport.abort()
-                try:
-                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
-                                    struct.pack('ii', 1, 0))
-                except OSError:
-                    pass
-                sock.close()
+                # validator path's transport.abort() — accounted
+                # through the same shed helper (traced + counted)
+                srv.note_shed('accept_refuse')
+                self._rst_close(sock)
                 continue
+            ov = srv.overload
+            delay = 0.0
+            if ov is not None:
+                # admission (io/overload.py): the global cap and this
+                # accept's shard cap, checked BEFORE adoption — an
+                # over-cap socket costs one RST, never a transport
+                k_probe = (shard_idx if shard_idx is not None
+                           else self._rr % self.nshards)
+                why = ov.admit(len(srv.conns),
+                               len(self.shards[k_probe].conns),
+                               self.nshards)
+                if why is not None:
+                    srv.note_shed(why)
+                    self._rst_close(sock)
+                    continue
+                delay = ov.pace_delay()
             try:
                 sock.setblocking(False)
                 sock.setsockopt(socket.IPPROTO_TCP,
@@ -533,15 +546,39 @@ class IngressPlane:
                 self._rr += 1
             else:
                 k = shard_idx
-            task = asyncio.ensure_future(self._adopt(sock, k))
+            task = asyncio.ensure_future(self._adopt(sock, k, delay))
             self._adopting.add(task)
             task.add_done_callback(self._adopting.discard)
 
-    async def _adopt(self, sock: socket.socket, shard_idx: int) -> None:
+    @staticmethod
+    def _rst_close(sock: socket.socket) -> None:
+        """Shed one accepted-but-never-adopted socket: linger-0 close
+        (RST) so the peer learns immediately and no FIN state lingers
+        through a connection flood."""
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack('ii', 1, 0))
+        except OSError:
+            pass
+        sock.close()
+
+    async def _adopt(self, sock: socket.socket, shard_idx: int,
+                     delay: float = 0.0) -> None:
         """Wrap one accepted socket in an asyncio transport (the send
         plane, fault gates and teardown paths all speak transport) —
-        reading paused from birth; the shard drain owns receive."""
+        reading paused from birth; the shard drain owns receive.
+        ``delay`` is the handshake pacer's verdict: an over-window
+        accept adopts late, flattening a dial wave."""
         loop = ambient_loop()
+        if delay > 0.0:
+            await asyncio.sleep(delay)
+            if not self.running:
+                self.server.note_shed('pacer_shutdown')
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
         try:
             await loop.connect_accepted_socket(
                 lambda: _ShardProtocol(self, shard_idx), sock)
@@ -619,6 +656,45 @@ class IngressPlane:
         shard = self.shards[conn._ingress_shard]
         shard.conns.discard(conn)
 
+    # -- rx pause (the overload plane's inflight throttle) --------------
+
+    def pause_rx(self, conn) -> None:
+        """Stop draining one connection (io/overload.py): unregister
+        its readiness callback so it can never go dirty — the kernel
+        socket buffer then fills and TCP flow control pushes back on
+        the client.  No user-space queue forms; that is the point."""
+        fd = conn._rx_fd
+        if fd < 0:
+            return
+        try:
+            remove = getattr(ambient_loop(), '_remove_reader', None)
+            if remove is not None:
+                remove(fd)
+        except (OSError, ValueError, RuntimeError):
+            pass
+
+    def resume_rx(self, conn) -> None:
+        """Re-register a paused connection's reader and force one
+        drain: bytes that arrived during the pause already sit in the
+        kernel buffer, and a level-triggered selector only reports
+        them to a registered reader."""
+        if conn.closed or conn._rx_fd < 0:
+            return
+        try:
+            ambient_loop()._add_reader(conn._rx_fd, self._on_readable,
+                                       conn)
+        except (OSError, ValueError, RuntimeError):
+            conn._rx_fd = -1
+            return
+        conn._rx_skip = False
+        if not conn._rx_dirty:
+            conn._rx_dirty = True
+            shard = self.shards[conn._ingress_shard]
+            shard.dirty.append(conn)
+            if not shard.scheduled:
+                shard.scheduled = True
+                ambient_loop().call_soon(self._drain_shard, shard)
+
     # -- the batched drain ----------------------------------------------
 
     def _on_readable(self, conn) -> None:
@@ -626,7 +702,7 @@ class IngressPlane:
         the shard's one drain for the tick boundary.  Level-triggered
         readiness re-fires while a drain is pending — the dirty flag
         makes that a no-op."""
-        if conn._rx_dirty or conn.closed:
+        if conn._rx_dirty or conn.closed or conn._rx_paused:
             return
         if conn._rx_skip:
             # the event for bytes a drain already consumed this
@@ -650,7 +726,9 @@ class IngressPlane:
         fds = []
         for conn in dirty:
             conn._rx_dirty = False
-            if conn.closed or conn._rx_fd < 0:
+            if conn.closed or conn._rx_fd < 0 or conn._rx_paused:
+                # a paused connection's bytes wait in the kernel;
+                # resume_rx re-dirties it when the throttle lifts
                 continue
             conns.append(conn)
             fds.append(conn._rx_fd)
@@ -701,6 +779,13 @@ class IngressPlane:
                 keep = False
             if not keep:
                 conn.close()
+                continue
+            ov = self.server.overload
+            if ov is not None and not conn.closed:
+                # the drain is the natural per-conn-per-tick boundary
+                # for the hard tx watermark: a reply backlog that
+                # outgrew it evicts here
+                ov.check_tx(conn)
 
     def _clear_skips(self) -> None:
         """Head of the next loop iteration: un-skip every connection

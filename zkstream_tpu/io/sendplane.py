@@ -107,7 +107,7 @@ class SendPlane:
     __slots__ = ('_write', '_chunks', '_pending', '_scheduled',
                  'enabled', 'max_bytes', '_frames_hist', '_bytes_hist',
                  '_labels', '_barrier', '_ledger', '_tier', '_entry',
-                 '_syscall_ctr')
+                 '_syscall_ctr', '_transport_fn')
 
     def __init__(self, write, *, enabled: bool | None = None,
                  max_bytes: int | None = None,
@@ -124,6 +124,9 @@ class SendPlane:
         self._entry = (tier.channel(write, transport_fn)
                        if tier is not None and transport_fn is not None
                        else None)
+        #: Kept tier or no tier: :meth:`buffered_bytes` needs the live
+        #: transport to include its write buffer in the tx account.
+        self._transport_fn = transport_fn
         #: Optional utils/metrics.TickLedger (server planes): flush
         #: time lands in the ``cork_flush`` tick phase, loop-blocking
         #: barrier time in ``fsync_gate``.
@@ -169,6 +172,26 @@ class SendPlane:
     def pending(self) -> int:
         """Bytes appended but not yet flushed."""
         return self._pending
+
+    def buffered_bytes(self) -> int:
+        """Everything this connection has accepted for transmission
+        but not yet handed to the kernel: the cork's pending bytes,
+        the transport tier entry's deferred chunks, and the asyncio
+        transport's own write buffer — the tx-side account the
+        overload plane's watermarks compare against (io/overload.py).
+        A stalled reader grows exactly this number."""
+        n = self._pending
+        e = self._entry
+        if e is not None:
+            n += e.nbytes
+        t = (self._transport_fn() if self._transport_fn is not None
+             else None)
+        if t is not None:
+            try:
+                n += t.get_write_buffer_size()
+            except (OSError, RuntimeError, AttributeError):
+                pass
+        return n
 
     def send(self, data: bytes) -> None:
         """Append one encoded frame; it reaches the sink at the next

@@ -60,6 +60,12 @@ class ErrCode(enum.IntEnum):
     #: (server/election.py).  Typed, definite failure: the write was
     #: NOT applied; retry after the member rejoins the current epoch.
     EPOCH_FENCED = -130
+    #: This stack's own (no reference analogue): the serving member is
+    #: shedding load — its global memory watermark is crossed and new
+    #: writes bounce while reads keep flowing (io/overload.py).  Typed,
+    #: definite failure: the write was NOT applied; the client backs
+    #: off and retries (capped exponential, client.py).
+    THROTTLED = -131
 
 
 #: Human-readable explanations for ErrCode values
@@ -96,6 +102,9 @@ ERR_TEXT: dict[str, str] = {
     'EPOCH_FENCED': 'The serving member\'s leadership epoch is stale '
         '(a newer leader has been elected); the write was rejected, '
         'not applied',
+    'THROTTLED': 'The serving member is overloaded and shedding new '
+        'writes (reads keep flowing); the write was rejected, not '
+        'applied — back off and retry',
 }
 
 

@@ -50,6 +50,21 @@ class ZKDeadlineError(ZKProtocolError):
         self.deadline_ms = deadline_ms
 
 
+class ZKFrameTooLargeError(ZKProtocolError):
+    """An inbound length prefix exceeded the frame-size cap
+    (``ZKSTREAM_MAX_FRAME``, the ``jute.maxbuffer`` analogue).  Typed
+    so both directions can reject the frame BEFORE buffering it — a
+    corrupt or hostile 4-byte prefix must never make a peer try to
+    allocate gigabytes; ``code`` is ``'FRAME_TOO_LARGE'``."""
+
+    def __init__(self, length: int, cap: int):
+        super().__init__('FRAME_TOO_LARGE',
+            'Inbound ZK frame of %d bytes exceeds the %d-byte cap'
+            % (length, cap))
+        self.length = length
+        self.cap = cap
+
+
 class ZKNotConnectedError(ZKProtocolError):
     """An operation was attempted while no usable connection exists
     (reference: lib/errors.js:37-42)."""
@@ -74,6 +89,17 @@ class ZKError(Exception):
             self.errno: int | None = int(ErrCode[code])
         except KeyError:
             self.errno = None
+
+
+class ZKThrottledError(ZKError):
+    """The serving member bounced a write at its global memory
+    watermark (io/overload.py): a definite, typed failure — the write
+    was NOT applied.  Reads keep flowing on the same connection; the
+    client's write path backs off (capped exponential, the session's
+    retry policy) and re-issues."""
+
+    def __init__(self, message: str | None = None):
+        super().__init__('THROTTLED', message)
 
 
 class ZKMultiError(ZKError):

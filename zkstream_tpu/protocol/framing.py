@@ -22,11 +22,37 @@ import struct
 
 from . import records
 from .consts import MAX_PACKET
-from .errors import ZKProtocolError
+from .errors import ZKFrameTooLargeError, ZKProtocolError
 from .fastencode import FastEncoder
 from .jute import JuteReader, JuteWriter
 
 _LEN = struct.Struct('>i')
+
+MAX_FRAME_ENV = 'ZKSTREAM_MAX_FRAME'
+
+
+def frame_cap_default() -> int:
+    """The process-wide inbound frame-size cap (the ``jute.maxbuffer``
+    analogue): ``ZKSTREAM_MAX_FRAME`` bytes, clamped to the 16 MiB
+    protocol ceiling — a knob can only TIGHTEN the cap, never loosen
+    the decoder's sanity bound."""
+    raw = os.environ.get(MAX_FRAME_ENV)
+    if raw:
+        try:
+            v = int(raw)
+        except ValueError:
+            return MAX_PACKET
+        if v > 0:
+            return min(v, MAX_PACKET)
+    return MAX_PACKET
+
+
+def resolve_frame_cap(arg: int | None) -> int:
+    """Resolve an explicit constructor knob against the protocol
+    ceiling (None = process default)."""
+    if arg is None:
+        return frame_cap_default()
+    return min(int(arg), MAX_PACKET) if arg > 0 else MAX_PACKET
 
 
 class FrameDecoder:
@@ -39,10 +65,16 @@ class FrameDecoder:
     auto-detects; True/False force a path (tests, benchmarks).
     """
 
-    __slots__ = ('_buf', '_scanner')
+    __slots__ = ('_buf', '_scanner', '_max_frame')
 
-    def __init__(self, use_native: bool | None = None) -> None:
+    def __init__(self, use_native: bool | None = None,
+                 max_frame: int | None = None) -> None:
         self._buf = bytearray()
+        #: Inbound frame cap, checked against the 4-byte prefix BEFORE
+        #: any body byte is buffered — an oversized prefix raises the
+        #: typed :class:`ZKFrameTooLargeError` instead of making the
+        #: peer accumulate up to the prefix's claim.
+        self._max_frame = resolve_frame_cap(max_frame)
         self._scanner = None
         if use_native is not False:
             from ..utils import native
@@ -68,9 +100,11 @@ class FrameDecoder:
         try:
             while len(self._buf) - off >= 4:
                 (ln,) = _LEN.unpack_from(self._buf, off)
-                if ln < 0 or ln > MAX_PACKET:
+                if ln < 0:
                     raise ZKProtocolError('BAD_LENGTH',
                         'Invalid ZK packet length %d' % (ln,))
+                if ln > self._max_frame:
+                    raise ZKFrameTooLargeError(ln, self._max_frame)
                 if len(self._buf) - off < 4 + ln:
                     break
                 frames.append(bytes(self._buf[off + 4:off + 4 + ln]))
@@ -86,13 +120,16 @@ class FrameDecoder:
         exactly, including the BAD_LENGTH contract: complete frames
         before an invalid prefix are consumed-and-discarded and the
         buffer is left positioned at the offending prefix."""
-        spans, resid, bad_at = self._scanner.scan(self._buf, MAX_PACKET)
+        spans, resid, bad_at = self._scanner.scan(self._buf,
+                                                  self._max_frame)
         if bad_at is not None:
             if bad_at:
                 del self._buf[:bad_at]
             (ln,) = _LEN.unpack_from(self._buf, 0)
-            raise ZKProtocolError('BAD_LENGTH',
-                'Invalid ZK packet length %d' % (ln,))
+            if ln < 0:
+                raise ZKProtocolError('BAD_LENGTH',
+                    'Invalid ZK packet length %d' % (ln,))
+            raise ZKFrameTooLargeError(ln, self._max_frame)
         frames = [bytes(self._buf[s:s + z]) for s, z in spans]
         if resid:
             del self._buf[:resid]
@@ -129,8 +166,14 @@ class PacketCodec:
     """
 
     def __init__(self, server: bool = False,
-                 use_native: bool | None = None):
-        self._decoder = FrameDecoder(use_native=use_native)
+                 use_native: bool | None = None,
+                 max_frame: int | None = None):
+        self._decoder = FrameDecoder(use_native=use_native,
+                                     max_frame=max_frame)
+        #: The resolved inbound frame cap: one value drives all three
+        #: decode tiers (scalar loop, native scanner, C-extension
+        #: batch decode), so the rejection boundary cannot fork.
+        self._max_frame = self._decoder._max_frame
         self._server = server
         self.handshaking = True
         #: xid -> opcode for replies in flight
@@ -262,10 +305,10 @@ class PacketCodec:
         try:
             if self._server:
                 pkts, consumed, kind, msg = self._ext.decode_requests(
-                    buf, MAX_PACKET)
+                    buf, self._max_frame)
             else:
                 pkts, consumed, kind, msg = self._ext.decode_responses(
-                    buf, self.xid_map, MAX_PACKET)
+                    buf, self.xid_map, self._max_frame)
         except Exception as e:
             # Parity with the scalar path: ANY decode-side exception
             # (e.g. MemoryError) surfaces as connection-fatal
@@ -289,6 +332,15 @@ class PacketCodec:
             # error semantics; the next chunk re-enters the C tier
             return self._decode_scalar(b'', pkts)
         if kind is not None:
+            if kind == 'BAD_LENGTH' and len(buf) >= 4:
+                # scalar parity: the buffer is positioned at the
+                # offending prefix — a non-negative over-cap length is
+                # the typed frame-size rejection, not a corrupt prefix
+                (ln,) = _LEN.unpack_from(buf, 0)
+                if ln > self._max_frame and ln >= 0:
+                    err = ZKFrameTooLargeError(ln, self._max_frame)
+                    err.packets = pkts
+                    raise err
             err = ZKProtocolError(kind, msg)
             err.packets = pkts
             raise err

@@ -25,12 +25,14 @@ import logging
 import os
 import time
 
-from ..protocol.consts import XID_NOTIFICATION, CreateFlag
-from ..protocol.errors import ZKProtocolError
+from ..protocol.consts import MAX_PACKET, XID_NOTIFICATION, CreateFlag
+from ..protocol.errors import ZKFrameTooLargeError, ZKProtocolError
 from ..io.ingress import METRIC_RECV_SYSCALLS, make_plane, \
     rx_buf_default
+from ..io.overload import OverloadConfig, OverloadPlane, \
+    overload_enabled
 from ..io.sendplane import SendPlane
-from ..protocol.framing import PacketCodec
+from ..protocol.framing import PacketCodec, resolve_frame_cap
 from ..utils.aio import set_nodelay
 from ..utils.metrics import TickLedger
 from ..utils.trace import TRACE_SCHEMA, TraceRing, server_trace_default
@@ -262,7 +264,8 @@ class ServerConnection:
         self.store = server.store    # this member's view: reads + watches
         self.reader = reader
         self.writer = writer
-        self.codec = PacketCodec(server=True)
+        self.codec = PacketCodec(server=True,
+                                 max_frame=server.max_frame)
         self.session: ZKServerSession | None = None
         #: One-shot watch tables, local to this connection (they die
         #: with the server, exactly like real ZK's).  With the server's
@@ -296,6 +299,15 @@ class ServerConnection:
         self._rx_fd = -1
         self._rx_dirty = False
         self._rx_skip = False
+        #: Overload-plane state (io/overload.py): rx paused (reader
+        #: removed / validator loop parked) after an inflight storm,
+        #: the validator's resume event, the notification-drop
+        #: episode marker, and the eviction reason (None = never
+        #: evicted).
+        self._rx_paused = False
+        self._rx_resume: asyncio.Event | None = None
+        self._notif_dropping = False
+        self.evicted: str | None = None
         #: Outbound cork (io/sendplane.py): replies and notifications
         #: of one event-loop tick leave as a single writer.write (a
         #: pipelined request batch is answered with one segment) —
@@ -319,6 +331,13 @@ class ServerConnection:
                              tier=server.transport_tier,
                              transport_fn=lambda: getattr(
                                  self.writer, 'transport', None))
+
+    @property
+    def session_id(self):
+        """This connection's session id, None before the handshake —
+        what OVERLOAD trace spans name the victim by."""
+        sess = self.session
+        return sess.id if sess is not None else None
 
     # -- wire helpers --
 
@@ -392,6 +411,15 @@ class ServerConnection:
         encode cache/memo, shared across subscribers."""
         if self.closed:
             return
+        ov = self.server.overload
+        if ov is not None:
+            # soft tx watermark: a stalled subscriber loses watch
+            # notifications (the legally lossy channel) before it can
+            # bloat the member; the hard watermark evicts it outright
+            if not ov.allow_notification(self):
+                return
+            if ov.check_tx(self):
+                return
         self.server.packets_sent += 1
         self._write_bytes(
             self.server.encode_notification(ntype, path, zxid))
@@ -484,6 +512,14 @@ class ServerConnection:
         labels = self.server._recv_labels
         try:
             while not self.closed:
+                if self._rx_paused:
+                    # inflight throttle (io/overload.py): park the
+                    # pump instead of reading — the kernel buffer
+                    # fills and TCP pushes back on the client
+                    gate = self._rx_resume = asyncio.Event()
+                    await gate.wait()
+                    self._rx_resume = None
+                    continue
                 data = await self.reader.read(rx_buf)
                 if not data:
                     break
@@ -555,9 +591,25 @@ class ServerConnection:
         try:
             try:
                 pkts = self.codec.decode(data)
+            except ZKFrameTooLargeError as e:
+                # the jute.maxbuffer analogue: the length prefix is
+                # rejected BEFORE the frame buffers; the close is a
+                # traced, typed eviction, not a silent drop
+                ov = self.server.overload
+                if ov is not None:
+                    ov.evict(self, 'frame_too_large',
+                             buffered=e.length)
+                else:
+                    log.debug('server: oversized frame: %s', e)
+                return False
             except ZKProtocolError as e:
                 log.debug('server: undecodable input: %s', e)
                 return False
+            ov = self.server.overload
+            if ov is not None and pkts:
+                # an inflight storm — one drain decoding a whole
+                # pipelined burst — pauses this connection's rx
+                ov.after_drain(self, len(pkts))
             trace = self.server.trace
             if trace is not None and pkts and not (
                     len(pkts) == 1
@@ -596,6 +648,15 @@ class ServerConnection:
         finally:
             if ledger is not None:
                 ledger.exit()
+        ov = self.server.overload
+        if ov is not None and not self.closed:
+            # the validator twin of the ingress drain's hard-watermark
+            # boundary (io/ingress.py): a reply backlog that outgrew
+            # ZKSTREAM_TX_HARD evicts here too — a pipelined reader
+            # that stops draining must not bloat the member just
+            # because this server runs without the sharded ingress
+            if ov.check_tx(self):
+                return False
         return True
 
     def _handle_admin(self, word: str) -> None:
@@ -646,6 +707,34 @@ class ServerConnection:
         self.server.conns.discard(self)
         try:
             self.writer.close()
+        except (ConnectionError, RuntimeError):
+            pass
+
+    def abort(self) -> None:
+        """The evicting close (io/overload.py): DISCARD everything
+        buffered for this connection and reset the transport —
+        flushing into the wedged socket is exactly how the bloat
+        happened, so unlike :meth:`close` nothing is drained."""
+        if self.closed:
+            return
+        self.closed = True
+        self._fanout_buf.clear()
+        self._tx.reset()
+        self._unsubscribe()
+        if self._ingress is not None:
+            self._ingress.forget(self)
+        if self.session is not None and self.session.owner is self:
+            self.session.owner = None
+        self.server.conns.discard(self)
+        gate = self._rx_resume
+        if gate is not None:
+            gate.set()      # the parked validator pump exits its loop
+        try:
+            t = getattr(self.writer, 'transport', None)
+            if t is not None:
+                t.abort()
+            else:
+                self.writer.close()
         except (ConnectionError, RuntimeError):
             pass
 
@@ -714,6 +803,17 @@ class ServerConnection:
         if fence is not None and fence():
             raise ZKOpError('EPOCH_FENCED')
 
+    def _check_throttle(self, op: str) -> None:
+        """Global memory watermark (io/overload.py): a member whose
+        aggregate tx backlog crossed ``ZKSTREAM_MEM_SOFT`` is in
+        degraded mode — new writes bounce with the typed THROTTLED
+        error (definite failure, nothing applied; the client backs
+        off and retries) while reads keep flowing."""
+        ov = self.server.overload
+        if ov is not None and ov.write_throttled():
+            ov.count_throttled(op)
+            raise ZKOpError('THROTTLED')
+
     def _gated(self, pkt: dict) -> bool:
         """True when the zxid read gate parked this read: the serving
         member's replica trails what this session has already seen, so
@@ -733,6 +833,7 @@ class ServerConnection:
 
     def _op_create(self, pkt: dict) -> None:
         self._check_fence()
+        self._check_throttle('CREATE')
         path = self.db.create(pkt['path'], pkt['data'], pkt['acl'],
                               CreateFlag(pkt['flags']), self.session)
         # a write through this member catches its store up through the
@@ -743,6 +844,7 @@ class ServerConnection:
 
     def _op_delete(self, pkt: dict) -> None:
         self._check_fence()
+        self._check_throttle('DELETE')
         self.db.delete(pkt['path'], pkt['version'])
         self.store.catch_up()
         self._reply(pkt['xid'], 'DELETE')
@@ -760,6 +862,7 @@ class ServerConnection:
 
     def _op_set_data(self, pkt: dict) -> None:
         self._check_fence()
+        self._check_throttle('SET_DATA')
         stat = self.db.set_data(pkt['path'], pkt['data'], pkt['version'])
         self.store.catch_up()
         self._reply(pkt['xid'], 'SET_DATA', stat=stat)
@@ -811,6 +914,7 @@ class ServerConnection:
         failing op's code, RUNTIME_INCONSISTENCY elsewhere) with NO
         sub-op applied."""
         self._check_fence()
+        self._check_throttle('MULTI')
         results = self.db.multi(pkt['ops'], self.session)
         self.store.catch_up()
         self._reply(pkt['xid'], 'MULTI', results=results)
@@ -895,7 +999,10 @@ class ZKServer:
                  ingress_shards: int | None = None,
                  ingress_backend: str | None = None,
                  blackbox: bool | None = None,
-                 blackbox_dir: str | None = None):
+                 blackbox_dir: str | None = None,
+                 overload: bool | None = None,
+                 overload_config: OverloadConfig | None = None,
+                 max_frame: int | None = None):
         #: Durability plane (server/persist.py).  When this server
         #: owns its database (``db=None``) and a WAL directory is
         #: resolved — the ``wal_dir`` argument or ``ZKSTREAM_WAL_DIR``
@@ -1078,6 +1185,20 @@ class ZKServer:
         #: env-gated ungated validator the checker must catch.
         self.read_gate = (ReadGate(self, collector=collector)
                           if read_gate_enabled() else None)
+        #: The overload plane (io/overload.py): admission caps +
+        #: handshake pacer, the per-connection inflight rx throttle,
+        #: tx watermarks with slow-consumer eviction, and the global
+        #: memory watermark that bounces writes THROTTLED.  None =
+        #: ``ZKSTREAM_NO_OVERLOAD=1`` (or ``overload=False``), the
+        #: validator arm with the pre-overload byte-stream — which is
+        #: why ``max_frame`` pins to MAX_PACKET when the plane is off.
+        enabled_ov = (overload_enabled() if overload is None
+                      else overload)
+        self.max_frame = (resolve_frame_cap(max_frame) if enabled_ov
+                          else MAX_PACKET)
+        self.overload = (OverloadPlane(self, cfg=overload_config,
+                                       collector=collector)
+                         if enabled_ov else None)
         #: ``zookeeper_reconfig_ms`` histogram (lazy: registered on
         #: the first membership change this member drives, so the
         #: steady-state metric inventory is unchanged when dynamic
@@ -1188,16 +1309,49 @@ class ZKServer:
         log.info('ZK server listening on %s:%d', self.host, self.port)
         return self
 
+    def note_shed(self, reason: str) -> None:
+        """Account one pre-adoption shed: traced span + metric — the
+        bookkeeping half every shed path shares (the validator's
+        :meth:`shed_client` below and the ingress plane's RST shed,
+        io/ingress.py)."""
+        if self.trace is not None:
+            self.trace.note('OVERLOAD', kind='server',
+                            detail='shed:%s' % (reason,))
+        if self.overload is not None:
+            self.overload.count_shed(reason)
+
+    def shed_client(self, writer: asyncio.StreamWriter,
+                    reason: str) -> None:
+        """Shed one just-accepted client: account it, then abort the
+        transport (RST, no FIN handshake to babysit) — never the old
+        bare ``transport.abort()`` with no trace or metric."""
+        self.note_shed(reason)
+        try:
+            writer.transport.abort()
+        except (ConnectionError, RuntimeError):
+            pass
+
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
         if self.faults is not None and self.faults.accept_refuse():
             # Injected accept-loop refusal: the member is listening
             # but sheds this client (overload / half-dead member).
-            try:
-                writer.transport.abort()
-            except (ConnectionError, RuntimeError):
-                pass
+            self.shed_client(writer, 'accept_refuse')
             return
+        ov = self.overload
+        if ov is not None:
+            why = ov.admit(len(self.conns))
+            if why is not None:
+                self.shed_client(writer, why)
+                return
+            delay = ov.pace_delay()
+            if delay > 0.0:
+                # handshake pacer: over-window accepts adopt late,
+                # flattening a dial wave into a trickle
+                await asyncio.sleep(delay)
+                if not self.listening:
+                    self.shed_client(writer, 'pacer_shutdown')
+                    return
         set_nodelay(writer)
         conn = ServerConnection(self, reader, writer)
         self.conns.add(conn)
@@ -1557,7 +1711,10 @@ class ZKServer:
             ('zk_ingress_backend',
              'asyncio' if self.ingress is None
              else self.ingress.backend),
-        ] + self._ingress_census_rows() + multi_rows + gate_rows \
+        ] + self._ingress_census_rows() \
+            + (self.overload.mntr_rows()
+               if self.overload is not None else []) \
+            + multi_rows + gate_rows \
             + quorum_rows + config_rows + tick_rows + blackbox_rows \
             + wal_rows
 

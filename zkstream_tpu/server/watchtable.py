@@ -303,10 +303,18 @@ class WatchTable:
         srv.packets_sent += len(subs)
         shards = self._shards
         sched: list = []
+        ov = getattr(srv, 'overload', None)
         if kind == 'data':
             for conn in subs:
                 conn.data_watches.pop(path, None)
                 if conn.closed:
+                    srv.packets_sent -= 1
+                    continue
+                if ov is not None \
+                        and not ov.allow_notification(conn):
+                    # soft tx watermark (io/overload.py): a stalled
+                    # subscriber loses the frame — the legally lossy
+                    # channel — instead of bloating the member
                     srv.packets_sent -= 1
                     continue
                 buf = conn._fanout_buf
@@ -321,6 +329,10 @@ class WatchTable:
             for conn in subs:
                 conn.child_watches.pop(path, None)
                 if conn.closed:
+                    srv.packets_sent -= 1
+                    continue
+                if ov is not None \
+                        and not ov.allow_notification(conn):
                     srv.packets_sent -= 1
                     continue
                 buf = conn._fanout_buf
@@ -384,6 +396,9 @@ class WatchTable:
         injection happens HERE — before the cork, per frame, with a
         pre-flush of everything the connection already has corked —
         the same boundary rule the send plane uses."""
+        ov = getattr(self.server, 'overload', None)
+        if ov is not None and not ov.allow_notification(conn):
+            return
         self.server.packets_sent += 1
         fi = self.server.faults
         if fi is not None and fi.server_tx(conn, data,
@@ -418,6 +433,7 @@ class WatchTable:
         t0 = time.perf_counter()
         frames = 0
         nbytes = 0
+        ov = getattr(self.server, 'overload', None)
         try:
             for conn in dirty:
                 buf = conn._fanout_buf
@@ -433,6 +449,11 @@ class WatchTable:
                     continue
                 nbytes += len(data)
                 conn._tx.send_flush(data)
+                if ov is not None:
+                    # the flush is the fan-out's per-conn-per-tick
+                    # boundary: a subscriber whose backlog outgrew
+                    # the hard watermark is evicted right here
+                    ov.check_tx(conn)
         finally:
             if ledger is not None:
                 ledger.exit()
