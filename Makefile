@@ -6,13 +6,13 @@
 PYTHON ?= python3
 LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
-.PHONY: all test check analyze native bench asan ubsan sanitize \
-    chaos chaos-ensemble obs durability election linearize \
+.PHONY: all test check analyze native loadgen bench asan ubsan \
+    sanitize chaos chaos-ensemble obs durability election linearize \
     reconfig overload \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
     bench-read bench-reconfig bench-blackbox bench-overload \
-    timeline coverage clean
+    bench-million timeline coverage clean
 
 all: check test
 
@@ -161,7 +161,7 @@ bench-ingress: native
 # the tick-ledger phase table per table-arm cell (table in PROFILE.md
 # "Fan-out plane").  Rounds via ZKSTREAM_BENCH_FANOUT_ROUNDS; narrow
 # with --sessions/--watchers.
-bench-fanout:
+bench-fanout: loadgen
 	$(PYTHON) bench.py --fanout
 
 # Read scale-out envelope (README "Read plane"): paired cells at
@@ -176,8 +176,22 @@ bench-fanout:
 # Rounds via ZKSTREAM_BENCH_READ_ROUNDS, window via
 # ZKSTREAM_BENCH_READ_SECS; narrow with --sessions/--workloads.
 # Table in PROFILE.md "Read plane".
-bench-read:
+bench-read: loadgen
 	$(PYTHON) bench.py --read
+
+# The million-session campaign (README "Load generation"; PROFILE.md
+# round 19): ONE C-loadgen run per member count against a real
+# leader + observers fleet — handshake wave, keepalive-only hold
+# with live pings, a watch armed per session, fan-out rounds through
+# every armed watcher, and a post-failover-shaped SET_WATCHES storm.
+# Member RSS/fd counts scraped at the all-connected peak; when the
+# host fd/memory cap bounds the session count the cell names it in
+# caps.binding_constraint.  The default is a tier-1-safe 2000 x 2s
+# smoke; the real campaign scales with
+# ZKSTREAM_BENCH_MILLION_SESSIONS=1000000 (plus _MEMBERS, _SECS,
+# _RAMP — see README "Load generation").
+bench-million: loadgen
+	$(PYTHON) bench.py --million
 
 # Overload-plane envelope (README "Overload plane"): paired
 # stalled-consumer defense cells (defense on vs overload=False — the
@@ -268,7 +282,18 @@ analyze:
 native:
 	$(PYTHON) -c "from zkstream_tpu.utils import native; \
 	    p = native.build(); print(p or 'native build unavailable'); \
-	    q = native.build_ext(); print(q or 'ext build unavailable')"
+	    q = native.build_ext(); print(q or 'ext build unavailable'); \
+	    r = native.build_loadgen(); \
+	    print(r or 'loadgen build unavailable')"
+
+# Build the raw-socket C load generator (tools/loadgen.c ->
+# native/zkloadgen.vN).  Same capability-probed discipline as the
+# codecs: graceful skip without a compiler (benches then fall back
+# to the Python worker arm and say so).
+loadgen:
+	$(PYTHON) -c "from zkstream_tpu.utils import native; \
+	    p = native.build_loadgen(); \
+	    print(p or 'loadgen build unavailable')"
 
 # Memory-safety check: AddressSanitizer build of the extension driven
 # with valid corpora + a 20k-round mutation storm (tools/asan_check.py).

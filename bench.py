@@ -2015,6 +2015,15 @@ class _NullWriter:
         return default
 
 
+#: Measured decode ceiling of ONE Python client pump (round 15 ran 8
+#: read_worker processes into ~89k ops/s aggregate, ~11k/s each — the
+#: "server" ceiling was the client's).  Every cell a Python client
+#: drives carries ``client_capped: true`` plus this number so its
+#: absolute throughput can't be mistaken for a server limit; the C
+#: loadgen cells (tools/loadgen.c) carry ``client_capped: false``.
+PY_CLIENT_CEILING_OPS = 11000
+
+
 async def fanout_cell(sessions: int, watchers: int, table: bool,
                       events: int | None = None,
                       collector=None) -> dict:
@@ -2092,6 +2101,11 @@ async def fanout_cell(sessions: int, watchers: int, table: bool,
     p50, p99 = _percentiles(lat_ms)
     out = {'sessions': sessions, 'watchers': watchers,
            'table': table, 'events': events,
+           # paired A/B cell driven by one in-process Python loop:
+           # relative deltas are honest, absolute rates are capped by
+           # the Python driver (see the loadgen fan-out cells)
+           'client_capped': True,
+           'client_ceiling_ops_per_sec': PY_CLIENT_CEILING_OPS,
            'event_ms_mean': round(sum(lat_ms) / len(lat_ms), 3),
            'event_ms_p50': round(p50, 3),
            'event_ms_p99': round(p99, 3),
@@ -2205,6 +2219,26 @@ def bench_fanout() -> None:
                                         / max(1, len(deltas)), 1),
                 'sign_p': round(sign_test_p(wins, losses), 4),
             }), flush=True)
+    # absolute cells: the null-transport family above isolates
+    # dispatch cost but its driver is Python (client_capped); these
+    # push REAL notifications through real sockets — every session
+    # holds a watch, the loadgen's writer fires, and the cell times
+    # mutation -> all-notifications-on-the-wire per round
+    from zkstream_tpu.utils import loadgen as _lg
+    if _lg.mode() == 'c' and _lg.available() is not None:
+        for s in sessions_sweep:
+            try:
+                cell = asyncio.run(_loadgen_fleet_cell(
+                    1, s, duration=0, arm_watch=True,
+                    fanout_sets=5))
+            except Exception as e:
+                print('# fanout loadgen cell %d failed: %r'
+                      % (s, e), file=sys.stderr)
+                continue
+            if cell is None:
+                break
+            print('# fanout_loadgen_cell %s' % (json.dumps(cell),),
+                  file=sys.stderr)
 
 
 #: `bench.py --transport` sweep (the batched-syscall transport-tier
@@ -2483,6 +2517,10 @@ async def transport_cell(conns: int, workload: str, backend: str,
            'backend': backend, 'resolved_backend': resolved,
            'ingress_backend': resolved_ingress,
            'ingress_shards': resolved_shards,
+           # one Python pump paces every event: the A/B delta is the
+           # measurement, the absolute rate is the client's ceiling
+           'client_capped': True,
+           'client_ceiling_ops_per_sec': PY_CLIENT_CEILING_OPS,
            'events': events,
            'event_ms_mean': round(sum(lat_ms) / len(lat_ms), 3),
            'event_ms_p50': round(p50, 3),
@@ -2739,6 +2777,25 @@ def bench_ingress() -> None:
                                         / max(1, len(deltas)), 1),
                 'sign_p': round(sign_test_p(wins, losses), 4),
             }), flush=True)
+    # absolute cells: the paired family above is paced by one
+    # in-process Python pump (client_capped in its JSON); these
+    # re-measure the same widths with the C loadgen driving a real
+    # leader process — write-heavy steady load plus the unpaced
+    # handshake wave, the numbers the ingress tier is actually for
+    from zkstream_tpu.utils import loadgen as _lg
+    if _lg.mode() == 'c' and _lg.available() is not None:
+        for conns in conns_sweep:
+            try:
+                cell = asyncio.run(_loadgen_fleet_cell(
+                    1, conns, duration=2.0, mix='set=100'))
+            except Exception as e:
+                print('# ingress loadgen cell %d failed: %r'
+                      % (conns, e), file=sys.stderr)
+                continue
+            if cell is None:
+                break
+            print('# ingress_loadgen_cell %s' % (json.dumps(cell),),
+                  file=sys.stderr)
 
 
 #: `bench.py --read` (`make bench-read`): read-serving member counts
@@ -2803,17 +2860,45 @@ async def _read_cell(members: int, sessions: int, workload: str,
         await c.wait_connected(timeout=20)
         await c.create('/bench', b'x' * 128)
 
-        nworkers = max(1, min(8, (os.cpu_count() or 2) - members))
-        per = sessions // nworkers
-        addrs = ','.join('127.0.0.1:%d' % (m.client_port,)
-                         for m in fleet)
-        for w in range(nworkers):
-            n = per + (sessions - per * nworkers if w == 0 else 0)
+        # driver arm: the C loadgen (tools/loadgen.c) by default —
+        # one process, epoll threads, streaming decode — with the
+        # Python read_worker pool kept as the ZKSTREAM_LOADGEN=py
+        # validator arm (parity-checked in tests/test_loadgen.py).
+        # Both speak the same READY/GO stdio protocol.
+        from zkstream_tpu.utils import loadgen as lg
+        lg_cmd = None
+        if lg.mode() == 'c':
+            lg_cmd = lg.argv(
+                [('127.0.0.1', m.client_port) for m in fleet],
+                sessions, duration=duration_s, mix='get=100',
+                path='/bench', stdio_sync=True,
+                session_timeout_ms=120000, close_sessions=True,
+                ensure_path=False)
+            if lg_cmd is None:
+                print('# C loadgen unavailable (no compiler?); '
+                      'falling back to the Python worker arm',
+                      file=sys.stderr)
+        driver = 'c' if lg_cmd is not None else 'py'
+        nworkers = 0
+        if driver == 'c':
             procs.append(subprocess.Popen(
-                [sys.executable, READ_WORKER, addrs, str(n),
-                 '%g' % (duration_s,)],
-                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                lg_cmd, stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL, text=True))
+        else:
+            nworkers = max(1, min(8, (os.cpu_count() or 2)
+                                  - members))
+            per = sessions // nworkers
+            addrs = ','.join('127.0.0.1:%d' % (m.client_port,)
+                             for m in fleet)
+            for w in range(nworkers):
+                n = per + (sessions - per * nworkers
+                           if w == 0 else 0)
+                procs.append(subprocess.Popen(
+                    [sys.executable, READ_WORKER, addrs, str(n),
+                     '%g' % (duration_s,)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True))
         connected = 0
         for p in procs:
             line = await asyncio.wait_for(
@@ -2840,7 +2925,11 @@ async def _read_cell(members: int, sessions: int, workload: str,
                 duration_s + 120)
             outs.append(json.loads(line))
             p.wait()
-        reads = sum(o['reads'] for o in outs)
+        if driver == 'c':
+            summary = outs[0]
+            reads = summary['window']['ops']
+        else:
+            reads = sum(o['reads'] for o in outs)
         # quiet-phase write burst: the read window is over, so this
         # isolates what ATTACHING OBSERVERS costs a write (replication
         # pushes to N mirrors) from where the read load happened to
@@ -2855,10 +2944,32 @@ async def _read_cell(members: int, sessions: int, workload: str,
         qlat.sort()
         cell = {
             'members': members, 'sessions': connected,
-            'workload': workload,
-            'read': {'ops_per_sec': round(reads / duration_s, 1)},
-            'reader_errors': sum(o['errors'] for o in outs),
+            'workload': workload, 'driver': driver,
         }
+        if driver == 'c':
+            cell['client_capped'] = False
+            cell['read'] = {
+                'ops_per_sec': summary['window']['ops_per_sec']}
+            cell['reader_errors'] = (
+                sum(v['errors'] for v in summary['ops'].values())
+                + summary['errors']['io']
+                + summary['errors']['proto'])
+            cell['zxid'] = summary['zxid']
+            cell['handshake'] = summary.get('handshake')
+            cell['loadgen_rc'] = procs[0].returncode
+        else:
+            # the Python arm is the validator: its absolute rate is
+            # the client pool's decode ceiling, not the server's
+            cell['client_capped'] = True
+            cell['client_ceiling'] = {
+                'workers': nworkers,
+                'per_worker_ops_per_sec': round(
+                    reads / duration_s / max(1, nworkers), 1),
+                'decode_ceiling_ops_per_sec':
+                    PY_CLIENT_CEILING_OPS}
+            cell['read'] = {
+                'ops_per_sec': round(reads / duration_s, 1)}
+            cell['reader_errors'] = sum(o['errors'] for o in outs)
         if write_lat:
             lat = sorted(write_lat)
             cell['write'] = {
@@ -2907,6 +3018,181 @@ async def _read_cell(members: int, sessions: int, workload: str,
             except Exception:
                 pass
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _proc_stats(pid: int) -> dict:
+    """RSS + open-fd count of one process, from /proc."""
+    out: dict = {}
+    try:
+        with open('/proc/%d/status' % pid) as f:
+            for ln in f:
+                if ln.startswith('VmRSS:'):
+                    out['rss_mb'] = round(
+                        int(ln.split()[1]) / 1024.0, 1)
+                    break
+        out['fds'] = len(os.listdir('/proc/%d/fd' % pid))
+    except OSError:
+        pass
+    return out
+
+
+async def _loadgen_fleet_cell(members: int, sessions: int, *,
+                              duration=None, mix=None, ramp=None,
+                              idle_ping=None, arm_watch=False,
+                              fanout_sets=None,
+                              setwatches_storm=False,
+                              pipeline=None) -> dict | None:
+    """One ABSOLUTE (non-paired) cell: a real leader + observers
+    fleet driven by the C loadgen.  The loadgen's READY/GO stdio sync
+    lets us scrape every member's RSS and fd count at the
+    all-sessions-connected peak before the load window opens.
+    Returns the loadgen summary annotated with the fleet shape, or
+    None when the binary can't be built (no compiler)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    from zkstream_tpu.server.election import (
+        ProcMember,
+        allocate_ports,
+        find_leader,
+    )
+    from zkstream_tpu.utils import loadgen as lg
+
+    import asyncio
+
+    if lg.available() is None:   # build before spawning the fleet
+        return None
+    loop = asyncio.get_running_loop()
+    root = tempfile.mkdtemp(prefix='zkbench-lg-')
+    ports = allocate_ports(2 * members)
+    # each member sees ~sessions/members connections (round-robin);
+    # tell it so it can lift its fd limit before the wave hits, and
+    # lift the overload plane's admission cap (default 4096, a
+    # production defense) to the same budget — the campaign measures
+    # the HOST's fd ceiling, not the admission knob's default
+    need = -(-sessions // members) + 1024
+    os.environ['ZKSTREAM_MEMBER_FDS'] = str(need)
+    os.environ['ZKSTREAM_MAX_CONNS'] = str(need)
+    fleet = [ProcMember(i, os.path.join(root, 'm%d' % i),
+                        ports[2 * i], ports[2 * i + 1],
+                        observer=i > 0)
+             for i in range(members)]
+    proc = None
+    try:
+        for m in fleet:
+            m.spawn(fleet)
+        for m in fleet:
+            await m.wait_ready()
+        await find_leader(fleet, min_epoch=1)
+        # the session timeout must cover the WHOLE connect wave: no
+        # pings flow while a thread is still handshaking, and this
+        # host's single-core accept path sustains ~1.5k handshakes/s
+        # — a fixed 120 s timeout would expire the first sessions of
+        # any wave past ~180k before the last one connects
+        st_ms = max(120000, int(sessions * 1.5))
+        cmd = lg.argv(
+            [('127.0.0.1', m.client_port) for m in fleet],
+            sessions, duration=duration, mix=mix, ramp=ramp,
+            idle_ping=idle_ping, arm_watch=arm_watch,
+            fanout_sets=fanout_sets,
+            setwatches_storm=setwatches_storm, pipeline=pipeline,
+            stdio_sync=True, session_timeout_ms=st_ms)
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        ready_s = 120.0 + sessions / 500.0
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, proc.stdout.readline),
+            ready_s)
+        assert line.startswith('READY'), line
+        connected = int(line.split()[1])
+        peak = [dict(_proc_stats(m.proc.pid),
+                     member=m.member_id, observer=m.observer)
+                for m in fleet if m.proc is not None]
+        proc.stdin.write('GO\n')
+        proc.stdin.flush()
+        win_s = (300.0 + (duration or 0.0)
+                 + sessions / 500.0
+                 + (60.0 if fanout_sets else 0.0)
+                 + (60.0 if setwatches_storm else 0.0))
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, proc.stdout.readline),
+            win_s)
+        proc.wait()
+        cell = dict(json.loads(line), members=members, driver='c',
+                    rc=proc.returncode)
+        cell['connected'] = connected
+        cell['members_at_peak'] = peak
+        return cell
+    finally:
+        os.environ.pop('ZKSTREAM_MEMBER_FDS', None)
+        os.environ.pop('ZKSTREAM_MAX_CONNS', None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        if proc is not None:
+            try:
+                proc.stdout.close()
+                proc.stdin.close()
+            except Exception:
+                pass
+        for m in fleet:
+            try:
+                m.kill()
+            except Exception:
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_million() -> None:
+    """The million-session campaign (`make bench-million`): ONE
+    loadgen run per member count against a real leader + observers
+    fleet — handshake wave (optionally paced with
+    ZKSTREAM_BENCH_MILLION_RAMP handshakes/s), keepalive-only hold
+    window with live pings, a watch armed per session, fan-out
+    rounds through every armed watcher, and a post-failover-shaped
+    SET_WATCHES storm.  Member RSS and fd counts are scraped at the
+    all-connected peak; when the host fd/memory cap (not the server)
+    bounds the session count, the cell says so by name in
+    ``caps.binding_constraint``.
+
+    The default is tier-1-safe (2000 sessions x 2s); the real
+    campaign (PROFILE.md round 19) scales with
+    ZKSTREAM_BENCH_MILLION_SESSIONS=1000000,
+    ZKSTREAM_BENCH_MILLION_MEMBERS=3 (comma-list),
+    ZKSTREAM_BENCH_MILLION_SECS and ZKSTREAM_BENCH_MILLION_RAMP."""
+    import asyncio
+
+    from zkstream_tpu.utils import loadgen as lg
+
+    if lg.mode() != 'c' or lg.available() is None:
+        print('# bench-million needs the C loadgen (no compiler or '
+              'ZKSTREAM_LOADGEN=py); nothing to run',
+              file=sys.stderr)
+        return
+    env = os.environ.get
+    sessions = int(env('ZKSTREAM_BENCH_MILLION_SESSIONS', '2000'))
+    member_list = [int(x) for x in
+                   env('ZKSTREAM_BENCH_MILLION_MEMBERS',
+                       '3').split(',') if x]
+    secs = float(env('ZKSTREAM_BENCH_MILLION_SECS', '2'))
+    ramp = float(env('ZKSTREAM_BENCH_MILLION_RAMP', '0'))
+    for members in member_list:
+        try:
+            cell = asyncio.run(_loadgen_fleet_cell(
+                members, sessions, duration=secs,
+                ramp=ramp if ramp > 0 else None,
+                idle_ping=max(1.0, secs / 2.0),
+                arm_watch=True, fanout_sets=3,
+                setwatches_storm=True, pipeline=1))
+        except Exception as e:
+            print('# million cell m=%d s=%d failed: %r'
+                  % (members, sessions, e), file=sys.stderr)
+            continue
+        if cell is None:
+            return
+        print('# million_cell %s' % (json.dumps(cell),),
+              file=sys.stderr)
 
 
 def bench_read() -> None:
@@ -3161,6 +3447,16 @@ def main() -> None:
         from zkstream_tpu.utils.platform import force_cpu
         force_cpu(n_devices=1)
         bench_read()
+        return
+    if '--million' in sys.argv:
+        # `make bench-million`: the million-session campaign (README
+        # "Load generation"; PROFILE.md round 19) — handshake waves,
+        # keepalive hold, per-session watches with fan-out, and a
+        # SET_WATCHES storm, driven by the C loadgen against a real
+        # member fleet.  Host-path only.
+        from zkstream_tpu.utils.platform import force_cpu
+        force_cpu(n_devices=1)
+        bench_million()
         return
     if '--write' in sys.argv:
         # `make bench-write`: the write-heavy client-ops cell family

@@ -46,15 +46,19 @@ def main() -> int:
         sys.path.insert(0, root)
     from zkstream_tpu.server.election import run_member
 
-    # a read-plane member may serve thousands of sessions (`make
-    # bench-read`): lift the soft fd limit toward the hard one
-    import resource
-    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-    if soft < hard:
-        try:
-            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
-        except (ValueError, OSError):
-            pass
+    # a read-plane member may serve up to a million sessions (`make
+    # bench-million`): lift the soft fd limit as far as the host
+    # allows, and name the binding constraint when it can't
+    # (utils/fdlimit.py — ZKServer.start does the same against its
+    # admission ceiling)
+    from zkstream_tpu.utils import fdlimit
+    need = int(os.environ.get('ZKSTREAM_MEMBER_FDS', '0') or 0)
+    fdlimit.raise_nofile(need + 256 if need else None)
+    if need:
+        err = fdlimit.headroom_error(need)
+        if err:
+            print('member %s fd headroom: %s'
+                  % (sys.argv[1], err), file=sys.stderr)
 
     member_id = int(sys.argv[1])
     wal_dir = sys.argv[2]

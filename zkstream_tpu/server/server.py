@@ -1199,6 +1199,10 @@ class ZKServer:
         self.overload = (OverloadPlane(self, cfg=overload_config,
                                        collector=collector)
                          if enabled_ov else None)
+        #: Per-instance listen backlog (shadows the class default):
+        #: ``ZKSTREAM_LISTEN_BACKLOG`` > the kernel's somaxconn clamp
+        #: > the class default — see the note at the class attribute.
+        self.BACKLOG = self._resolve_backlog()
         #: ``zookeeper_reconfig_ms`` histogram (lazy: registered on
         #: the first membership change this member drives, so the
         #: steady-state metric inventory is unchanged when dynamic
@@ -1284,11 +1288,43 @@ class ZKServer:
                     c.close()
 
     #: Listen backlog: the asyncio default (100) drops handshakes
-    #: under a thundering herd of reconnects at fleet scale — a
-    #: member serving 10k connections must survive 10k dials.
+    #: under a thundering herd of reconnects at fleet scale.  The old
+    #: default here (1024) was set against Python-client waves; the C
+    #: loadgen's measured handshake storms arrive faster than one
+    #: accept sweep drains, so the default now matches the kernel's
+    #: own clamp (``net.core.somaxconn``, 4096 on the profiled host —
+    #: anything above it is silently truncated anyway).  Override
+    #: with ``ZKSTREAM_LISTEN_BACKLOG``; PROFILE.md round 19 has the
+    #: wave numbers this was re-derived from.
     BACKLOG = 1024
 
+    @staticmethod
+    def _resolve_backlog() -> int:
+        env = os.environ.get('ZKSTREAM_LISTEN_BACKLOG')
+        if env:
+            try:
+                return max(1, int(env))
+            except ValueError:
+                pass
+        try:
+            with open('/proc/sys/net/core/somaxconn') as f:
+                return max(ZKServer.BACKLOG, int(f.read().strip()))
+        except (OSError, ValueError):
+            return ZKServer.BACKLOG
+
     async def start(self) -> 'ZKServer':
+        # Million-session enabler: lift the soft fd limit to what the
+        # admitted-connection ceiling needs, and say WHICH limit binds
+        # when the host cap wins (never a bare EMFILE mid-accept).
+        from ..utils import fdlimit
+        max_conns = (self.overload.cfg.max_conns
+                     if self.overload is not None else None)
+        if max_conns:
+            fdlimit.raise_nofile(max_conns + 256)
+            err = fdlimit.headroom_error(max_conns)
+            if err:
+                log.warning('%s (admission ceiling %d will shed '
+                            'above the fd fit)', err, max_conns)
         if self.blackbox is not None:
             self.blackbox.start(asyncio.get_running_loop())
         if self.ingress is not None:

@@ -331,6 +331,53 @@ def ensure_ext():
     return get_ext()
 
 
+# -- C load generator (tools/loadgen.c) -------------------------------
+#
+# A standalone binary, not a shared library: it drives the real wire
+# protocol over raw sockets (the measuring instrument the bench
+# families spawn instead of the Python read workers — README "Load
+# generation").  Same discipline as the other two artifacts:
+# version-named output, atomic tmp+rename publish, graceful None when
+# the host has no compiler so `make check`/tier-1 never hard-fail on
+# a codec-less image.
+
+_LOADGEN_VERSION = 1
+
+
+def loadgen_source_path() -> str:
+    return os.path.join(_root(), 'tools', 'loadgen.c')
+
+
+def loadgen_path() -> str:
+    return os.path.join(_root(), 'native',
+                        'zkloadgen.v%d' % _LOADGEN_VERSION)
+
+
+def build_loadgen() -> str | None:
+    """Compile the load generator if missing or stale; return its
+    path or None.  Synchronous (tools/bench only, never the event
+    loop)."""
+    src, out = loadgen_source_path(), loadgen_path()
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + '.tmp.%d' % os.getpid()
+    cmd = ['gcc', '-O2', '-pthread', src, '-o', tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info('loadgen build unavailable: %s', e)
+        return None
+    if r.returncode != 0:
+        log.warning('loadgen build failed: %s', r.stderr.strip())
+        return None
+    os.replace(tmp, out)
+    return out
+
+
 class NativeFrameScanner:
     """ctypes facade over zkwire_frame_scan for one connection.
 
