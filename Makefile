@@ -8,7 +8,7 @@ LINT_TARGETS = zkstream_tpu tests tools bench.py __graft_entry__.py
 
 .PHONY: all test check analyze native loadgen bench asan ubsan \
     sanitize chaos chaos-ensemble obs durability election linearize \
-    reconfig overload \
+    reconfig overload cache \
     bench-wal bench-fanout bench-trace bench-election \
     bench-transport bench-ingress bench-quorum bench-linearize \
     bench-read bench-reconfig bench-blackbox bench-overload \
@@ -94,6 +94,22 @@ overload:
 	$(PYTHON) -m pytest tests/test_overload.py -q -m 'not slow'
 	$(PYTHON) -m pytest tests/test_overload.py -q -m slow \
 	    -k overload_campaign
+
+# Client cache plane (io/cache.py; README "Client cache plane"):
+# persistent / persistent-recursive watch semantics (ADD_WATCH,
+# SET_WATCHES2 replay), the watch-backed cache units — serve gate,
+# fill gate, invalidation, knob resolution, metrics — plus the
+# cached-client chaos slices on both tiers (every cached read rides
+# the same check_session_reads invariant as a wire read).  Rerun a
+# failing seed with `python -m zkstream_tpu chaos --tier ensemble
+# --cached --seed N` (or --tier process).  The full 120-schedule
+# cached campaign is the slow marker (test_cached_campaign_full).
+cache:
+	$(PYTHON) -m pytest tests/test_cache.py -q
+	$(PYTHON) -m pytest tests/test_chaos_ensemble.py -q \
+	    -k 'cached' -m 'not slow'
+	$(PYTHON) -m pytest tests/test_process_ensemble.py -q \
+	    -k 'cached'
 
 # Failover-time envelope: paired leader-kill cells at 3- vs 5-member
 # in-process ensembles — kill the leader, time detection -> elected
@@ -263,7 +279,7 @@ linearize:
 bench-linearize:
 	$(PYTHON) tools/bench_linearize.py
 
-check: analyze
+check: analyze cache
 	$(PYTHON) tools/lint.py $(LINT_TARGETS)
 
 # Semantic static analysis (tools/zkanalyze.py -> zkstream_tpu/

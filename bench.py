@@ -2813,7 +2813,7 @@ READ_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 async def _read_cell(members: int, sessions: int, workload: str,
-                     duration_s: float) -> dict:
+                     duration_s: float, cached: bool = False) -> dict:
     """One read-plane cell: spawn 1 voter + (members-1) observer
     processes, park ``sessions`` raw-socket read sessions across them
     (reader worker processes, tools/read_worker.py), pipeline
@@ -2873,11 +2873,13 @@ async def _read_cell(members: int, sessions: int, workload: str,
                 sessions, duration=duration_s, mix='get=100',
                 path='/bench', stdio_sync=True,
                 session_timeout_ms=120000, close_sessions=True,
-                ensure_path=False)
+                ensure_path=False, cached=cached)
             if lg_cmd is None:
                 print('# C loadgen unavailable (no compiler?); '
                       'falling back to the Python worker arm',
                       file=sys.stderr)
+        if cached and lg_cmd is None:
+            raise RuntimeError('cached read arm needs the C loadgen')
         driver = 'c' if lg_cmd is not None else 'py'
         nworkers = 0
         if driver == 'c':
@@ -2950,6 +2952,20 @@ async def _read_cell(members: int, sessions: int, workload: str,
             cell['client_capped'] = False
             cell['read'] = {
                 'ops_per_sec': summary['window']['ops_per_sec']}
+            # server_ops_per_sec is the wire rate the SERVER saw: for
+            # the cached arm local hits never cross the wire, so only
+            # the invalidation-driven refills count against it
+            cache = summary.get('cache')
+            if cache is not None:
+                secs = summary['window']['secs']
+                cell['cache'] = cache
+                cell['read']['server_ops_per_sec'] = round(
+                    cache['wire_reads_win'] / secs, 1) if secs else 0.0
+                cell['read']['local_hits_per_sec'] = cache.get(
+                    'hits_per_sec', 0.0)
+            else:
+                cell['read']['server_ops_per_sec'] = (
+                    summary['window']['ops_per_sec'])
             cell['reader_errors'] = (
                 sum(v['errors'] for v in summary['ops'].values())
                 + summary['errors']['io']
@@ -3290,6 +3306,90 @@ def bench_read() -> None:
                 # attached" (the quorum never widened)
                 sign('read_write_p50_sign_test', writes,
                      sessions, wl, n, higher_is_better=False)
+
+    _bench_read_cached(rounds, duration)
+
+
+def _bench_read_cached(rounds: int, duration: float) -> None:
+    """The cached arm of `bench.py --read` (README "Client cache
+    plane"): paired uncached-vs-cached C-loadgen cells against the
+    same single-member fleet shape.  The cached arm arms one
+    persistent-recursive ADD_WATCH per session (io/cache.py shape)
+    and serves steady reads from the local entry, so the server only
+    sees invalidation-driven refill reads.  Acceptance: server-side
+    read QPS reduced >= 95% on every pair (exact sign test at the
+    95% bar, not at break-even) and cached p50 in single-digit
+    microseconds.  Narrow with ZKSTREAM_BENCH_READ_CACHED_ROUNDS /
+    _SESSIONS; table in PROFILE.md "Read plane"."""
+    import asyncio as aio
+
+    from zkstream_tpu.utils import loadgen as lg
+    from zkstream_tpu.utils.metrics import sign_test_p
+
+    if lg.mode() != 'c' or lg.available() is None:
+        print('# cached read arm needs the C loadgen (no compiler '
+              'or ZKSTREAM_LOADGEN=py); skipped', file=sys.stderr)
+        return
+    rounds = int(os.environ.get('ZKSTREAM_BENCH_READ_CACHED_ROUNDS',
+                                str(rounds)))
+    sessions = int(os.environ.get(
+        'ZKSTREAM_BENCH_READ_CACHED_SESSIONS', '100'))
+    pairs: list[tuple[dict, dict]] = []
+    best: dict = {}
+    for _rnd in range(rounds):
+        row: dict = {}
+        for cached in (False, True):
+            arm = 'cached' if cached else 'uncached'
+            try:
+                r = aio.run(_read_cell(1, sessions, 'read', duration,
+                                       cached=cached))
+            except Exception as e:
+                print('# cached read cell %s s=%d failed: %r'
+                      % (arm, sessions, e), file=sys.stderr)
+                row = {}
+                break
+            row[arm] = r
+            if arm not in best or (r['read']['ops_per_sec']
+                                   > best[arm]['read']['ops_per_sec']):
+                best[arm] = r
+        if row:
+            pairs.append((row['uncached'], row['cached']))
+    for arm in sorted(best):
+        print('# read_cached_cell %s'
+              % (json.dumps(dict(best[arm], arm=arm)),),
+              file=sys.stderr)
+    if not pairs:
+        return
+    # exact sign test AT THE 95% BAR: a pair only counts as a win
+    # when the cached arm's server-side read rate is below 5% of the
+    # uncached arm's — break-even or a mere improvement is a loss
+    wins = losses = 0
+    reductions: list[float] = []
+    p50s: list[float] = []
+    for u, cc in pairs:
+        uq = u['read']['server_ops_per_sec']
+        cq = cc['read']['server_ops_per_sec']
+        if uq > 0:
+            reductions.append((uq - cq) / uq * 100.0)
+        if cq < uq * 0.05:
+            wins += 1
+        else:
+            losses += 1
+        p50s.append(cc['cache']['hit_p50_us'])
+    print(json.dumps({
+        'metric': 'read_cached_qps_reduction_sign_test',
+        'pair': 'cached-vs-uncached',
+        'bar': 'server read QPS reduced >= 95%',
+        'sessions': sessions,
+        'rounds': len(pairs),
+        'wins': wins,
+        'losses': losses,
+        'mean_reduction_pct': round(
+            sum(reductions) / max(1, len(reductions)), 2),
+        'cached_hit_p50_us': round(
+            sorted(p50s)[len(p50s) // 2], 3),
+        'sign_p': round(sign_test_p(wins, losses), 4),
+    }), flush=True)
 
 
 def _guard_backend(timeout_s: float | None = None) -> None:

@@ -73,10 +73,10 @@ static PyObject *g_op_names;   /* dict int -> str: EVERY valid OpCode */
 /* interned key + special-opcode strings */
 static PyObject *s_xid, *s_zxid, *s_err, *s_opcode, *s_data, *s_stat,
     *s_path, *s_children, *s_acl, *s_type, *s_state, *s_watch,
-    *s_version, *s_relZxid, *s_events, *s_flags;
+    *s_version, *s_relZxid, *s_events, *s_flags, *s_mode;
 static PyObject *s_notification, *s_ping, *s_auth, *s_set_watches, *s_ok;
 static PyObject *s_dataChanged, *s_createdOrDestroyed,
-    *s_childrenChanged;
+    *s_childrenChanged, *s_persistent, *s_persistentRecursive;
 /* MULTI (opcode 14) framing: result/ops keys + sub-op names */
 static PyObject *s_results, *s_op, *s_ops, *s_op_create, *s_op_delete,
     *s_op_set_data, *s_op_check, *s_op_error;
@@ -106,6 +106,8 @@ enum {
   RQ_SET_DATA = 5,
   RQ_SET_WATCHES = 6,
   RQ_MULTI = 7,
+  RQ_ADD_WATCH = 8,
+  RQ_SET_WATCHES2 = 9,
 };
 
 /* ---- byte readers (big-endian, bounds-checked) ---- */
@@ -545,15 +547,26 @@ static int decode_req_body(Cursor *c, PyObject *pkt, int layout) {
       if (!need(c, 4)) return -1;
       return set_steal(pkt, s_version, PyLong_FromLong(rd_i32(c)));
     }
-    case RQ_SET_WATCHES: {
+    case RQ_ADD_WATCH: {
+      /* AddWatchRequest: path + AddWatchMode int (opcode 106) */
+      if (set_steal(pkt, s_path, rd_string(c)) < 0) return -1;
+      if (!need(c, 4)) return -1;
+      return set_steal(pkt, s_mode, PyLong_FromLong(rd_i32(c)));
+    }
+    case RQ_SET_WATCHES:
+    case RQ_SET_WATCHES2: {
+      /* SET_WATCHES2 appends the two persistent lists after the
+       * three legacy one-shot lists — same framing otherwise */
+      int nkinds = layout == RQ_SET_WATCHES2 ? 5 : 3;
       if (!need(c, 8)) return -1;
       PyObject *rel = PyLong_FromLongLong(rd_i64(c));
       if (set_steal(pkt, s_relZxid, rel) < 0) return -1;
       PyObject *events = PyDict_New();
       if (events == NULL) return -1;
-      PyObject *kinds[3] = {s_dataChanged, s_createdOrDestroyed,
-                            s_childrenChanged};
-      for (int k = 0; k < 3; ++k) {
+      PyObject *kinds[5] = {s_dataChanged, s_createdOrDestroyed,
+                            s_childrenChanged, s_persistent,
+                            s_persistentRecursive};
+      for (int k = 0; k < nkinds; ++k) {
         if (!need(c, 4)) {
           Py_DECREF(events);
           return -1;
@@ -999,7 +1012,18 @@ static int enc_req_body(WBuf *w, PyObject *pkt, int layout) {
       wr_i32(w, (int32_t)flags);
       return 1;
     }
-    default: /* SET_WATCHES is resume-time-rare; Python handles it */
+    case RQ_ADD_WATCH: {
+      /* only the two defined AddWatchMode values encode verbatim;
+       * anything else falls back so the Python spec raises its own
+       * validation error */
+      int64_t mode;
+      if (!wr_str_field(w, pkt, s_path)
+          || !get_i64(pkt, s_mode, 0, 1, &mode))
+        return 0;
+      wr_i32(w, (int32_t)mode);
+      return 1;
+    }
+    default: /* SET_WATCHES/2 are resume-time-rare; Python handles them */
       return 0;
   }
 }
@@ -1243,7 +1267,7 @@ static PyObject *py_decode_requests(PyObject *self, PyObject *args) {
 }
 
 static PyObject *py_abi_version(PyObject *self, PyObject *noargs) {
-  return PyLong_FromLong(9);
+  return PyLong_FromLong(10);
 }
 
 /* CRC32C (Castagnoli, reflected 0x82F63B78) for the write-ahead-log
@@ -2144,6 +2168,7 @@ PyMODINIT_FUNC PyInit__zkwire_ext(void) {
   s_relZxid = PyUnicode_InternFromString("relZxid");
   s_events = PyUnicode_InternFromString("events");
   s_flags = PyUnicode_InternFromString("flags");
+  s_mode = PyUnicode_InternFromString("mode");
   s_notification = PyUnicode_InternFromString("NOTIFICATION");
   s_ping = PyUnicode_InternFromString("PING");
   s_auth = PyUnicode_InternFromString("AUTH");
@@ -2153,6 +2178,9 @@ PyMODINIT_FUNC PyInit__zkwire_ext(void) {
   s_createdOrDestroyed =
       PyUnicode_InternFromString("createdOrDestroyed");
   s_childrenChanged = PyUnicode_InternFromString("childrenChanged");
+  s_persistent = PyUnicode_InternFromString("persistent");
+  s_persistentRecursive =
+      PyUnicode_InternFromString("persistentRecursive");
   s_results = PyUnicode_InternFromString("results");
   s_op = PyUnicode_InternFromString("op");
   s_ops = PyUnicode_InternFromString("ops");

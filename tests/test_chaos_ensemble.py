@@ -431,6 +431,69 @@ async def test_forced_election_schedules_pass_invariants():
     assert not bad, _campaign_failure_report(bad)
 
 
+@pytest.mark.timeout(300)
+async def test_cached_client_schedules_pass_invariants():
+    """The cache plane's ensemble-tier slice (`chaos --tier ensemble
+    --cached`): schedules whose clients run with the watch-backed
+    client cache on (cache='/'), single-client and concurrent, must
+    pass every invariant — check_session_reads in particular holds
+    the no-time-travel rung on every locally served read.  The
+    slice also asserts the cache actually engaged: across the
+    schedules the exported zookeeper_cache_hits total is non-zero
+    (a cache that never serves is not under test)."""
+    import re
+
+    from zkstream_tpu.io.faults import run_concurrent_schedule
+
+    bad = []
+    hits = 0.0
+    for seed in (BASE_SEED, BASE_SEED + 1):
+        collector = Collector()
+        r = await run_ensemble_schedule(seed, cached=True,
+                                        collector=collector)
+        _assert_clean_scrape(collector, r)
+        text = collector.expose()
+        assert 'zookeeper_cache_hits' in text
+        hits += sum(float(m) for m in re.findall(
+            r'^zookeeper_cache_hits\{[^}]*\} (\S+)', text, re.M))
+        if not r.ok:
+            bad.append(r)
+    for seed in (BASE_SEED + 2, BASE_SEED + 3):
+        collector = Collector()
+        r = await run_concurrent_schedule(seed, clients=3,
+                                          cached=True,
+                                          collector=collector)
+        assert any(rec['kind'] == 'invoke' for rec in r.history), \
+            'seed %d recorded no interval ops' % (seed,)
+        _assert_clean_scrape(collector, r)
+        hits += sum(float(m) for m in re.findall(
+            r'^zookeeper_cache_hits\{[^}]*\} (\S+)',
+            collector.expose(), re.M))
+        if not r.ok:
+            bad.append(r)
+    assert not bad, _campaign_failure_report(bad)
+    assert hits > 0, 'cache never served across the cached slice'
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(2400)
+async def test_cached_campaign_full():
+    """The cache plane's acceptance campaign (slow-marked): >= 120
+    seeded CONCURRENT schedules with cached clients through the full
+    fault vocabulary (kills, elections, partitions, reconfig), zero
+    check_session_reads violations — a cached read can never
+    time-travel, under any schedule."""
+    from zkstream_tpu.io.faults import run_concurrent_schedule
+
+    bad = []
+    for seed in range(BASE_SEED, BASE_SEED + SCHEDULES):
+        r = await run_concurrent_schedule(seed, clients=3,
+                                          cached=True)
+        if not r.ok:
+            bad.append(r)
+    assert not bad, _campaign_failure_report(bad)
+
+
 async def test_schedule_runs_on_static_leader_fallback(monkeypatch):
     """ZKSTREAM_NO_ELECTION=1 keeps the static member-0 leader as the
     env-gated validator path: the same seeded schedule runs with no

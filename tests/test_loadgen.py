@@ -288,3 +288,40 @@ async def test_thousand_session_smoke(event_loop):
         assert caps['sessions_clamped'] is False
     finally:
         await srv.stop()
+
+
+# -- pillar 5: cached arm (ADD_WATCH + local hit simulation) ------------
+
+
+async def test_cached_arm_add_watch_and_local_hits(event_loop):
+    """--cached arms one persistent-recursive ADD_WATCH per session,
+    serves steady reads from the local entry (no wire traffic), and
+    every writer-churn notification invalidates exactly one refill
+    read.  Wire reads therefore track invalidations, not the read
+    rate, and the floor check still holds on every wire reply."""
+    from zkstream_tpu.server import ZKServer
+
+    srv = await ZKServer().start()
+    try:
+        sessions = 8
+        cmd = loadgen.argv([('127.0.0.1', srv.port)], sessions,
+                           duration=2, pipeline=4, path='/cbench',
+                           cached=True, cached_write_ms=100)
+        rc, s = await _run_loadgen(cmd, timeout=120)
+        assert rc == 0, s
+        assert s['connected'] == sessions
+        assert s['ops']['ADD_WATCH']['count'] == sessions
+        assert s['ops']['ADD_WATCH']['errors'] == 0
+        cache = s['cache']
+        assert cache['hits'] > 0
+        assert cache['invalidations'] > 0
+        # one wire refill per invalidation, like the client cache
+        assert cache['wire_reads_win'] <= cache['invalidations'] + sessions
+        assert cache['hit_ratio'] > 0.5
+        # local hits never cross the wire: single-digit microseconds
+        assert cache['hit_p50_us'] < 10.0
+        assert s['notifications'] >= cache['invalidations']
+        assert s['zxid']['floor_violations'] == 0
+        assert s['errors'] == {'connect': 0, 'io': 0, 'proto': 0}
+    finally:
+        await srv.stop()

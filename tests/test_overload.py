@@ -289,6 +289,110 @@ def test_hard_watermark_evicts_and_discards():
     assert plane.evictions == 1
 
 
+def test_soft_watermark_persistent_subscriber_evicted_not_gapped():
+    """Regression: the soft-watermark notification drop is only legal
+    for ONE-SHOT watches (the client re-arms and re-reads on
+    reconnect, closing the gap itself).  A PERSISTENT-watch
+    subscriber is a watch-backed cache relying on a gap-free
+    invalidation stream — a silent drop would leave it serving stale
+    data forever.  Over the soft watermark it must be EVICTED (typed
+    close, buffer discarded), never gapped."""
+    srv = _StubServer()
+    plane = OverloadPlane(srv, cfg=OverloadConfig(tx_soft=100,
+                                                  tx_hard=1000))
+    conn = _StubConn()
+    conn._tx.n = 50
+    assert plane.allow_persistent_notification(conn)   # under: flows
+    assert plane.persistent_evictions == 0
+    conn._tx.n = 150                   # over soft, under hard
+    assert not plane.allow_persistent_notification(conn)
+    assert conn.aborted                # evicted on the spot, not gapped
+    assert conn.evicted == 'persistent_gap'
+    assert plane.persistent_evictions == 1
+    assert plane.evictions == 1
+    assert plane.notifications_dropped == 0   # NOT the lossy channel
+    # a closed conn is a no-op, not a double count
+    assert plane.allow_persistent_notification(conn)
+    assert plane.persistent_evictions == 1
+
+
+@pytest.mark.timeout(60)
+async def test_stalled_persistent_subscriber_evicted_then_resyncs():
+    """The stalled-subscriber e2e shape with a PERSISTENT-watch
+    (cached) client: its tx backlog crosses the soft watermark, and
+    the next fan-out that would have been silently dropped for a
+    one-shot watch instead EVICTS it ('persistent_gap').  The client
+    observes the connection loss, marks its cached subtree stale,
+    re-dials, replays via SET_WATCHES2 and re-syncs — so a cached
+    read after recovery observes the write it missed while stalled.
+    Never a silent gap."""
+    import socket as socketmod
+    # the hard watermark is parked far away so the SOFT-watermark
+    # persistent gate is the defense under test, not check_tx
+    srv = await ZKServer(
+        overload_config=OverloadConfig(tx_soft=8 * 1024,
+                                       tx_hard=64 * 1024 * 1024)).start()
+    writer = Client(address='127.0.0.1', port=srv.port, **FAST)
+    cached = Client(address='127.0.0.1', port=srv.port,
+                    cache='/fan', session_timeout=10000, **FAST)
+    pending = []
+    try:
+        for c in (writer, cached):
+            c.start()
+            await c.wait_connected(timeout=5)
+        await wait_until(lambda: cached.cache.stats()['armed'] == 1)
+        await writer.create('/fan', b'f')
+        await writer.create('/fan/k', b'old')
+        await writer.create('/big', b'p' * (32 * 1024))
+        await cached.get('/fan/k')     # warm the cache
+        d, _ = await cached.get('/fan/k')
+        assert d == b'old'
+        assert cached.cache.stats()['hits'] >= 1
+        # Stall: shrink the receive window so the kernel can't mask
+        # the backlog, stop reading, then pipeline ~3 MB of fat reads
+        # so the tx account crosses the soft watermark.
+        dying = cached.current_connection()
+        tr = dying.transport
+        sock = tr.get_extra_info('socket')
+        if sock is not None:
+            sock.setsockopt(socketmod.SOL_SOCKET,
+                            socketmod.SO_RCVBUF, 4096)
+        tr.pause_reading()
+        pending = [asyncio.ensure_future(cached.get('/big'))
+                   for _ in range(100)]
+        await asyncio.sleep(0)         # let the requests hit the wire
+        # the writes' invalidations cannot be delivered while the
+        # replies are wedged in the tx account — the stalled
+        # persistent subscriber must be evicted, not gapped
+        for _ in range(20):
+            await writer.set('/fan/k', b'new', version=-1)
+            if srv.overload.persistent_evictions:
+                break
+        await wait_until(
+            lambda: srv.overload.persistent_evictions >= 1,
+            timeout=20)
+        assert srv.overload.notifications_dropped == 0
+        # recovery: the stalled client's reading is paused, so it
+        # only notices the abort when a ping write fails — wait for
+        # the connection loss, the re-dial, the SET_WATCHES2 replay
+        # and the cache resync; the read then observes the write it
+        # missed while stalled
+        await wait_until(lambda: not dying.is_in_state('connected'),
+                         timeout=20)
+        await cached.wait_connected(timeout=15, fail_fast=False)
+        await wait_until(lambda: cached.cache.stats()['armed'] == 1,
+                         timeout=15)
+        d, _ = await cached.get('/fan/k')
+        assert d == b'new', d
+    finally:
+        for t in pending:
+            t.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        for c in (writer, cached):
+            await c.close()
+        await srv.stop()
+
+
 @pytest.mark.timeout(60)
 async def test_stalled_subscriber_tx_bounded_and_evicted():
     """The acceptance shape, scaled to test time: one subscriber

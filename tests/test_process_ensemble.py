@@ -482,6 +482,24 @@ async def test_election_kill_loop_and_full_sigkill_generations():
     assert r.acked > 0
 
 
+@pytest.mark.timeout(240)
+async def test_process_tier_cached_clients_survive_leader_kills():
+    """The cache plane's OS-process slice (`chaos --tier process
+    --cached`): the seeded election schedule's clients run with the
+    watch-backed client cache on (cache='/') — leader SIGKILLs force
+    the cache through connection loss, SET_WATCHES2 replay and
+    resync, and every acked write must still read back correctly
+    through the (possibly cached) read path; invariant 7 and the
+    final read-back hold as in the uncached schedule."""
+    from zkstream_tpu.server.election import run_process_schedule
+
+    r = await run_process_schedule(seed=5, ops=3, elections=1,
+                                   generations=1, cached=True)
+    assert r.ok, r.violations
+    assert r.elections >= 2, r.history
+    assert r.acked > 0
+
+
 @pytest.mark.timeout(120)
 async def test_member_worker_role_via_test_worker():
     """The tests/ worker's `member` role delegates to the package

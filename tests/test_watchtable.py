@@ -42,6 +42,7 @@ class _StubConn:
     def __init__(self):
         self.data_watches = {}
         self.child_watches = {}
+        self.persistent_watches = {}
         self.closed = False
         self._fanout_buf = []
         self._fanout_shard = 0
@@ -84,12 +85,22 @@ async def test_table_index_count_and_cleanup():
     table.disarm('data', '/p', conns[1])
     assert table.count == 8
 
+    # persistent registrations live in their own indexes and counters
+    conns[2].persistent_watches['/p'] = False
+    table.arm_persistent('/p', conns[2], recursive=False)
+    conns[3].persistent_watches['/sub'] = True
+    table.arm_persistent('/sub', conns[3], recursive=True)
+    assert table.persistent_count == 1
+    assert table.recursive_count == 1
+
     # close-time cleanup is O(paths watched): index entries and the
-    # maintained count both drop
+    # maintained count both drop — persistent indexes included
     for c in conns[2:]:
         table.remove_conn(c)
     assert table.count == 2
     assert table.data_index['/p'] == {conns[0]}
+    assert table.persistent_count == 0 and not table.persistent_index
+    assert table.recursive_count == 0 and not table.recursive_index
 
     # one-shot consumption through a real store event
     db.create('/p', b'', [], 0)          # childrenChanged on '/'

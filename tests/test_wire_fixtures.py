@@ -120,6 +120,39 @@ REQUEST_FIXTURES = [
                     'childrenChanged': ['/c1', '/c2']}},
     ),
     (
+        'SET_WATCHES2',
+        # opcode 107 (upstream SetWatches2): the legacy three lists
+        # followed by the persistent and persistentRecursive lists,
+        # same reserved xid as SET_WATCHES
+        b'\xff\xff\xff\xf8'               # xid = -8
+        b'\x00\x00\x00\x6b'               # opcode SET_WATCHES2 = 107
+        b'\x00\x00\x00\x00\x00\x00\x00\x2a'  # relZxid = 42
+        b'\x00\x00\x00\x01'               # 1 data watch
+        b'\x00\x00\x00\x02/d'
+        b'\x00\x00\x00\x00'               # 0 exist watches
+        b'\x00\x00\x00\x00'               # 0 child watches
+        b'\x00\x00\x00\x01'               # 1 persistent watch
+        b'\x00\x00\x00\x02/p'
+        b'\x00\x00\x00\x01'               # 1 persistent-recursive watch
+        b'\x00\x00\x00\x02/r',
+        {'xid': -8, 'opcode': 'SET_WATCHES2', 'relZxid': 42,
+         'events': {'dataChanged': ['/d'],
+                    'createdOrDestroyed': [],
+                    'childrenChanged': [],
+                    'persistent': ['/p'],
+                    'persistentRecursive': ['/r']}},
+    ),
+    (
+        'ADD_WATCH',
+        # AddWatchRequest (upstream opcode 106): path ustring + mode
+        # int32 (AddWatchMode; 1 = PERSISTENT_RECURSIVE)
+        b'\x00\x00\x00\x11'               # xid = 17
+        b'\x00\x00\x00\x6a'               # opcode ADD_WATCH = 106
+        b'\x00\x00\x00\x02/a'             # path
+        b'\x00\x00\x00\x01',              # mode = PERSISTENT_RECURSIVE
+        {'xid': 17, 'opcode': 'ADD_WATCH', 'path': '/a', 'mode': 1},
+    ),
+    (
         'GET_DATA',
         b'\x00\x00\x00\x09'               # xid = 9
         b'\x00\x00\x00\x04'               # opcode GET_DATA = 4
@@ -292,6 +325,27 @@ RESPONSE_FIXTURES = [
         b'\x00\x00\x00\x00\x00\x00\x00\x14'   # zxid = 20
         b'\x00\x00\x00\x00',                  # err = OK, empty body
         {'xid': -8, 'zxid': 20, 'err': 'OK', 'opcode': 'SET_WATCHES'},
+    ),
+    (
+        'SET_WATCHES2',
+        # empty reply like SET_WATCHES; on the real wire it rides the
+        # reserved xid -8 (where the special-xid table names it under
+        # the legacy pseudo-opcode), so the five-list variant's reply
+        # is certified through the xid-map route instead
+        {18: 'SET_WATCHES2'},
+        b'\x00\x00\x00\x12'
+        b'\x00\x00\x00\x00\x00\x00\x00\x1a'   # zxid = 26
+        b'\x00\x00\x00\x00',                  # err = OK, empty body
+        {'xid': 18, 'zxid': 26, 'err': 'OK', 'opcode': 'SET_WATCHES2'},
+    ),
+    (
+        'ADD_WATCH',
+        # AddWatchResponse is empty: header-only on success
+        {17: 'ADD_WATCH'},
+        b'\x00\x00\x00\x11'
+        b'\x00\x00\x00\x00\x00\x00\x00\x19'   # zxid = 25
+        b'\x00\x00\x00\x00',                  # err = OK, empty body
+        {'xid': 17, 'zxid': 25, 'err': 'OK', 'opcode': 'ADD_WATCH'},
     ),
     (
         'PING',
@@ -490,6 +544,9 @@ ERROR_REPLY_FIXTURES = [
     ('SYNC', b'\xff\xff\xff\xfc', 'CONNECTION_LOSS'),          # -4
     ('SYNC', b'\xff\xff\xff\xf9', 'OPERATION_TIMEOUT'),        # -7
     ('SET_WATCHES', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),   # -112
+    # this stack's server rejects an unknown AddWatchMode outright
+    ('ADD_WATCH', b'\xff\xff\xff\xf8', 'BAD_ARGUMENTS'),
+    ('SET_WATCHES2', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),
     ('PING', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),
     ('CLOSE_SESSION', b'\xff\xff\xff\x90', 'SESSION_EXPIRED'),
     ('AUTH', b'\xff\xff\xff\x8d', 'AUTH_FAILED'),              # -115

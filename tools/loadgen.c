@@ -86,6 +86,7 @@
 #define OP_GET_CHILDREN 8
 #define OP_PING 11
 #define OP_SET_WATCHES 101
+#define OP_ADD_WATCH 106
 #define OP_CLOSE_SESSION (-11)
 
 #define XID_NOTIFICATION (-1)
@@ -98,11 +99,11 @@
 /* ---- op classes for accounting ---- */
 enum {
     CLS_GET = 0, CLS_EXISTS, CLS_LIST, CLS_CREATE, CLS_SET,
-    CLS_PING, CLS_ARM, CLS_SETW, CLS_CLOSE, CLS_N
+    CLS_PING, CLS_ARM, CLS_SETW, CLS_ADDW, CLS_CLOSE, CLS_N
 };
 static const char *CLS_NAME[CLS_N] = {
     "GET_DATA", "EXISTS", "GET_CHILDREN", "CREATE", "SET_DATA",
-    "PING", "WATCH_ARM", "SET_WATCHES", "CLOSE_SESSION"
+    "PING", "WATCH_ARM", "SET_WATCHES", "ADD_WATCH", "CLOSE_SESSION"
 };
 
 /* ---- exit codes (tests/test_loadgen.py relies on these) ---- */
@@ -180,6 +181,8 @@ typedef struct {
     int close_sessions;      /* CLOSE_SESSION before closing sockets */
     double drain_s;
     int quiet;
+    int cached;              /* ADD_WATCH(recursive) arm + local cache sim */
+    double cached_write_s;   /* writer churn interval during CACHED steady */
 } cfg_t;
 
 /* ---- per-connection state ---- */
@@ -193,6 +196,8 @@ typedef struct conn {
     int fd;
     uint8_t state;       /* 0 closed, 1 connecting, 2 hs sent, 3 ready */
     uint8_t armed;       /* data watch currently armed */
+    uint8_t cache_valid; /* cached mode: local entry serves without wire */
+    uint8_t refill_inflight; /* cached mode: one wire refill at a time */
     uint8_t in_epoll_out;
     int32_t next_xid;
     int64_t session_id;
@@ -203,6 +208,7 @@ typedef struct conn {
     uint8_t *wbuf; uint32_t wlen, woff, wcap;
     int64_t t_connect_ns, t_ready_ns;
     int64_t t_ping_ns, t_setw_ns, t_last_tx_ns;
+    int64_t t_invalidated_ns;        /* cached mode: notification arrival */
     int32_t quota_left;              /* count mode */
     int32_t fanout_round_seen;
 } conn_t;
@@ -235,8 +241,12 @@ typedef struct {
     uint64_t proto_errs, floor_violations, connect_errs, io_errs;
     uint64_t bytes_rx, bytes_tx, tx_syscalls, rx_syscalls;
     int64_t max_zxid, acked_write_zxid;
+    uint64_t cache_hits, cache_hits_win, cache_invalidations;
+    int64_t t_last_cset_ns;          /* cached mode: last writer churn */
     res_t lat[CLS_N];      /* reply latency, microseconds */
     res_t hs;              /* handshake latency */
+    res_t cache_hit_lat;   /* local cached-read latency, microseconds */
+    res_t cache_refill_lat;/* invalidation -> refilled entry, microseconds */
     int64_t first_ready_ns, last_ready_ns;
     int phase_done;        /* this thread finished current phase */
     /* steady refill round-robin cursor + ping sweep cursor */
@@ -405,6 +415,14 @@ static void build_templates(thr_t *th) {
     be32(t + o, 0); o += 4;
     th->tpl_len[CLS_SETW] = tpl_finish(t, o);
     th->tpl_xid_off[CLS_SETW] = 0;
+    /* ADD_WATCH path mode=1 (PERSISTENT_RECURSIVE): arms the subtree
+     * once; fires survive delivery, so the cached arm never re-arms */
+    t = th->tpl[CLS_ADDW];
+    o = tpl_begin(t, OP_ADD_WATCH);
+    o = tpl_str(t, o, C.path);
+    be32(t + o, 1); o += 4;
+    th->tpl_len[CLS_ADDW] = tpl_finish(t, o);
+    th->tpl_xid_off[CLS_ADDW] = 4;
     /* CLOSE_SESSION: header only */
     t = th->tpl[CLS_CLOSE];
     o = tpl_begin(t, OP_CLOSE_SESSION);
@@ -505,6 +523,23 @@ static void conn_fail(thr_t *th, conn_t *c, int io) {
 }
 
 /* ---- steady-state op selection ---- */
+static int in_window(int64_t t_ns);
+
+/* cached mode: a read served from the valid local entry never touches
+ * the wire.  The latency sample is a clock pair around the (trivial)
+ * lookup — the honest cost of a hit in this simulation. */
+static void cached_hit(thr_t *th) {
+    int64_t t0 = now_ns();
+    th->cache_hits++;
+    if (in_window(t0)) th->cache_hits_win++;
+    res_add(&th->cache_hit_lat, &th->rng,
+            (double)(now_ns() - t0) / 1000.0);
+}
+
+static int is_read_cls(int cls) {
+    return cls == CLS_GET || cls == CLS_EXISTS || cls == CLS_LIST;
+}
+
 static int pick_cls(thr_t *th) {
     int total = 0;
     for (int i = 0; i < CLS_N; i++) total += C.weights[i];
@@ -525,7 +560,22 @@ static void refill(thr_t *th, conn_t *c) {
     if (C.idle_ping_s > 0) return;          /* keepalive-only mode */
     if (C.count_per_session > 0) {
         while (c->quota_left > 0 && c->q_len < (uint32_t)C.pipeline) {
-            if (send_op(th, c, pick_cls(th))) break;
+            int cls = pick_cls(th);
+            if (C.cached && is_read_cls(cls)) {
+                if (c->cache_valid) {
+                    cached_hit(th);
+                    c->quota_left--;
+                    continue;
+                }
+                /* one wire refill per invalidation, like the client
+                 * cache: further reads wait for it */
+                if (c->refill_inflight) break;
+                if (send_op(th, c, cls)) break;
+                c->refill_inflight = 1;
+                c->quota_left--;
+                continue;
+            }
+            if (send_op(th, c, cls)) break;
             c->quota_left--;
         }
         return;
@@ -533,8 +583,24 @@ static void refill(thr_t *th, conn_t *c) {
     long end_ms = atomic_load_explicit(&g_window_end_ms,
                                        memory_order_relaxed);
     if ((now_ns() - g_t0_ns) / 1000000 >= end_ms) return;
+    /* duration mode: cached hits never occupy a ring slot, so cap them
+     * per call or a hot cache would spin here and starve the epoll
+     * loop that delivers the very invalidations being measured */
+    uint32_t hits = 0;
     while (c->q_len < (uint32_t)C.pipeline) {
-        if (send_op(th, c, pick_cls(th))) break;
+        int cls = pick_cls(th);
+        if (C.cached && is_read_cls(cls)) {
+            if (c->cache_valid) {
+                cached_hit(th);
+                if (++hits >= 8u * (uint32_t)C.pipeline) break;
+                continue;
+            }
+            if (c->refill_inflight) break;
+            if (send_op(th, c, cls)) break;
+            c->refill_inflight = 1;
+            continue;
+        }
+        if (send_op(th, c, cls)) break;
     }
 }
 
@@ -596,6 +662,18 @@ static void handle_reply(thr_t *th, conn_t *c, const uint8_t *b,
         if (round >= 0)
             atomic_fetch_add_explicit(&g_fanout_notifs, 1,
                                       memory_order_relaxed);
+        if (C.cached) {
+            /* persistent watch: survives the fire, stays armed.  The
+             * notification is the invalidation signal — drop the local
+             * entry and stamp the arrival so the next GET reply can
+             * measure invalidation -> refill latency. */
+            if (c->cache_valid) {
+                c->cache_valid = 0;
+                c->t_invalidated_ns = t;
+                th->cache_invalidations++;
+            }
+            return;
+        }
         /* the watch was one-shot: it is GONE now whether this fired
          * from a fan-out round or a steady-window write.  Drop the
          * gauge and re-arm; the ARM ack re-raises it (a full ring
@@ -662,12 +740,26 @@ static void handle_reply(thr_t *th, conn_t *c, const uint8_t *b,
             if (zxid > th->acked_write_zxid)
                 th->acked_write_zxid = zxid;
         }
-        if (cls == CLS_ARM && !c->armed) {
+        if ((cls == CLS_ARM || cls == CLS_ADDW) && !c->armed) {
             c->armed = 1;
             atomic_fetch_add_explicit(&g_armed_now, 1,
                                       memory_order_relaxed);
         }
+        if (C.cached && cls == CLS_ADDW)
+            c->cache_valid = 1;
+        if (C.cached && is_read_cls(cls)) {
+            /* wire read refills the local entry; if an invalidation
+             * was pending, this reply closes the staleness window */
+            c->cache_valid = 1;
+            if (c->t_invalidated_ns) {
+                res_add(&th->cache_refill_lat, &th->rng,
+                        (double)(t - c->t_invalidated_ns) / 1000.0);
+                c->t_invalidated_ns = 0;
+            }
+        }
     }
+    if (C.cached && is_read_cls(cls))
+        c->refill_inflight = 0;
     res_add(&th->lat[cls], &th->rng, (double)(t - s->t_ns) / 1000.0);
     refill(th, c);
 }
@@ -1048,10 +1140,14 @@ static void *thread_main(void *arg) {
                 continue;
             }
             if (phase == PH_ARM) {
+                /* cached mode arms the subtree once with a persistent-
+                 * recursive ADD_WATCH; classic mode arms the one-shot
+                 * data watch via GET_DATA watch=1 */
+                int arm_cls = C.cached ? CLS_ADDW : CLS_ARM;
                 for (int i = 0; i < th->n_conns; i++) {
                     conn_t *c = &th->conns[i];
                     if (c->state == ST_READY
-                        && !send_op(th, c, CLS_ARM))
+                        && !send_op(th, c, arm_cls))
                         conn_flush(th, c);
                 }
             }
@@ -1121,6 +1217,24 @@ static void *thread_main(void *arg) {
                 if (c->state == ST_READY && c->q_len == 0) {
                     refill(th, c);
                     if (c->wlen) conn_flush(th, c);
+                }
+            }
+            /* cached mode: thread 0 stamps a periodic SET on the hot
+             * path so the steady window actually exercises the
+             * invalidate -> refill cycle instead of a never-stale
+             * cache */
+            if (C.cached && th->idx == 0 && C.cached_write_s > 0) {
+                int64_t tn = now_ns();
+                if (tn - th->t_last_cset_ns >=
+                        (int64_t)(C.cached_write_s * 1e9)) {
+                    for (int i = 0; i < th->n_conns; i++) {
+                        conn_t *c = &th->conns[i];
+                        if (c->state != ST_READY) continue;
+                        if (c->q_len >= (uint32_t)C.pipeline) continue;
+                        if (!send_op(th, c, CLS_SET)) conn_flush(th, c);
+                        th->t_last_cset_ns = tn;
+                        break;
+                    }
                 }
             }
             ping_sweep(th, (double)C.session_timeout_ms / 3000.0);
@@ -1281,9 +1395,12 @@ static void report(FILE *f, double steady_s, int connected,
     uint64_t notifs = 0, notif_win = 0, proto = 0, floorv = 0;
     uint64_t cerrs = 0, ioerrs = 0, brx = 0, btx = 0, ntx = 0, nrx = 0;
     int64_t max_zxid = 0, awz = 0;
-    res_t lat[CLS_N], hs;
+    uint64_t chits = 0, chits_win = 0, cinv = 0;
+    res_t lat[CLS_N], hs, chit, crefill;
     memset(&lat, 0, sizeof lat);
     memset(&hs, 0, sizeof hs);
+    memset(&chit, 0, sizeof chit);
+    memset(&crefill, 0, sizeof crefill);
     for (int t = 0; t < C.threads; t++) {
         thr_t *th = &T[t];
         for (int k = 0; k < CLS_N; k++) {
@@ -1308,6 +1425,17 @@ static void report(FILE *f, double steady_s, int connected,
         ntx += th->tx_syscalls; nrx += th->rx_syscalls;
         if (th->max_zxid > max_zxid) max_zxid = th->max_zxid;
         if (th->acked_write_zxid > awz) awz = th->acked_write_zxid;
+        chits += th->cache_hits;
+        chits_win += th->cache_hits_win;
+        cinv += th->cache_invalidations;
+        for (uint64_t i = 0;
+             i < (th->cache_hit_lat.n < RES_N
+                  ? th->cache_hit_lat.n : RES_N); i++)
+            res_add(&chit, &th->rng, th->cache_hit_lat.v[i]);
+        for (uint64_t i = 0;
+             i < (th->cache_refill_lat.n < RES_N
+                  ? th->cache_refill_lat.n : RES_N); i++)
+            res_add(&crefill, &th->rng, th->cache_refill_lat.v[i]);
     }
     uint64_t win_total = 0, all_total = 0;
     for (int k = 0; k < CLS_N; k++) {
@@ -1360,6 +1488,31 @@ static void report(FILE *f, double steady_s, int connected,
                 ops[CLS_SETW], g_setw_storm_s,
                 ops[CLS_SETW] / g_setw_storm_s);
     fprintf(f, ", \"notifications\": %" PRIu64, notifs);
+    if (C.cached) {
+        /* a miss is a read that had to go to the wire: the served
+         * GET/EXISTS/LIST ops.  hit_ratio over the steady window. */
+        uint64_t miss_win = ops_win[CLS_GET] + ops_win[CLS_EXISTS]
+            + ops_win[CLS_LIST];
+        uint64_t reads_win = chits_win + miss_win;
+        res_sort(&chit);
+        res_sort(&crefill);
+        fprintf(f, ", \"cache\": {\"hits\": %" PRIu64
+                ", \"hits_win\": %" PRIu64
+                ", \"wire_reads_win\": %" PRIu64
+                ", \"hit_ratio\": %.6f"
+                ", \"invalidations\": %" PRIu64
+                ", \"hit_p50_us\": %.3f, \"hit_p99_us\": %.3f"
+                ", \"refill_p50_us\": %.1f, \"refill_p99_us\": %.1f",
+                chits, chits_win, miss_win,
+                reads_win ? (double)chits_win / (double)reads_win : 0.0,
+                cinv,
+                res_pct(&chit, 50), res_pct(&chit, 99),
+                res_pct(&crefill, 50), res_pct(&crefill, 99));
+        if (steady_s > 0)
+            fprintf(f, ", \"hits_per_sec\": %.1f",
+                    (double)chits_win / steady_s);
+        fprintf(f, "}");
+    }
     fprintf(f, ", \"zxid\": {\"floor_violations\": %" PRIu64
             ", \"max_zxid\": %" PRId64
             ", \"acked_write_max_zxid\": %" PRId64 "}",
@@ -1507,11 +1660,16 @@ int main(int argc, char **argv) {
         else if (!strcmp(a, "--drain"))
             C.drain_s = arg_d(argc, argv, &i);
         else if (!strcmp(a, "--quiet")) C.quiet = 1;
+        else if (!strcmp(a, "--cached")) C.cached = 1;
+        else if (!strcmp(a, "--cached-write-ms"))
+            C.cached_write_s = arg_d(argc, argv, &i) / 1000.0;
         else die("unknown flag %s", a);
     }
     if (!C.n_servers) die("--servers HOST:PORT[,HOST:PORT] required");
     if (C.sessions < 1) die("--sessions must be >= 1");
     if (C.pipeline < 1) C.pipeline = 1;
+    if (C.cached && C.cached_write_s <= 0)
+        C.cached_write_s = 0.1;  /* 10 invalidations/s default churn */
     if (C.data_len > 400) C.data_len = 400;  /* template fits 512 */
     if (C.threads <= 0) {
         long ncpu = sysconf(_SC_NPROCESSORS_ONLN);
@@ -1590,7 +1748,7 @@ int main(int argc, char **argv) {
         nanosleep(&ts, NULL);   /* let ensure-path settle */
     }
 
-    if (C.arm_watch || C.fanout_sets) {
+    if (C.arm_watch || C.fanout_sets || C.cached) {
         atomic_store(&g_phase, PH_ARM);
         wait_phase(PH_ARM);
     }

@@ -474,6 +474,19 @@ def build_parser() -> argparse.ArgumentParser:
                          'refuse with a definite close.  Part of '
                          'the rerun key like --clients.  Default: '
                          'drawn per seed')
+    ch.add_argument('--cached', action='store_true',
+                    help='ensemble/process tiers: run every '
+                         "schedule's clients with the watch-backed "
+                         'client cache on (README "Client cache '
+                         'plane", io/cache.py cache="/"): reads are '
+                         'served from the persistent-recursive-'
+                         'watch-backed local cache whenever '
+                         'coherent, and check_session_reads must '
+                         'still hold on every locally served read '
+                         '(a cached read can never time-travel). '
+                         'Part of the rerun key like --clients.  '
+                         'Default: drawn per seed (ensemble tier) / '
+                         'off (process tier)')
     ch.add_argument('--reconfig', action='store_true',
                     help='force membership reconfigurations into '
                          'every schedule (README "Dynamic '
@@ -714,7 +727,10 @@ async def _chaos(args) -> int:
             # --overload likewise forces two pressure bursts per
             # schedule (flood / stalled reader / oversized frame)
             overloads=2 if getattr(args, 'overload', False)
-            else None)
+            else None,
+            # --cached forces the watch-backed client cache on for
+            # every schedule (default: drawn per seed)
+            cached=True if getattr(args, 'cached', False) else None)
     elif args.tier == 'process':
         if getattr(args, 'no_election', False):
             # the process tier IS the election plane: there is no
@@ -736,7 +752,8 @@ async def _chaos(args) -> int:
             elections=getattr(args, 'elections', None),
             clients=getattr(args, 'clients', None),
             observers=getattr(args, 'observers', None),
-            reconfig=getattr(args, 'reconfig', False))
+            reconfig=getattr(args, 'reconfig', False),
+            cached=getattr(args, 'cached', False))
     else:
         if getattr(args, 'clients', None) and args.clients > 1:
             print('error: --clients needs the history-checked '
@@ -757,6 +774,12 @@ async def _chaos(args) -> int:
             print('error: --overload needs an ensemble; use '
                   '--tier ensemble (the transport tier draws its '
                   'own overload slice per seed)', file=sys.stderr)
+            return 2
+        if getattr(args, 'cached', False):
+            print('error: --cached needs the history-checked '
+                  'tiers (check_session_reads is what holds the '
+                  'cache coherent); use --tier ensemble or --tier '
+                  'process', file=sys.stderr)
             return 2
         results = await run_campaign(
             args.seed, args.schedules,

@@ -38,8 +38,9 @@ from .io.pool import (
     read_distribution_default,
     read_subset_default,
 )
+from .io.cache import CachePlane, cache_roots_default
 from .io.session import ZKSession
-from .io.watcher import ZKWatcher
+from .io.watcher import ZKPersistentWatcher, ZKWatcher
 from .io.overload import overload_enabled
 from .protocol.consts import MAX_PACKET, CreateFlag
 from .protocol.errors import ZKDeadlineError, ZKNotConnectedError, \
@@ -94,7 +95,8 @@ class Client(FSM):
                  read_distribution: bool | None = None,
                  read_subset: int | None = None,
                  resolver: Resolver | None = None,
-                 max_frame: int | None = None):
+                 max_frame: int | None = None,
+                 cache: bool | str | list[str] | None = None):
         if servers is None:
             assert address is not None, 'address or servers[] required'
             backends = [Backend(address, port)]
@@ -229,6 +231,24 @@ class Client(FSM):
         #: client (the primary session's own floor lives in
         #: ``session.last_zxid``); :meth:`last_seen_zxid` is the max.
         self._read_floor = 0
+        #: Watch-backed client cache (io/cache.py, README "Client
+        #: cache plane"): ``cache=`` names the subtree root(s) to
+        #: subscribe (True = '/'); None = env resolution
+        #: (``ZKSTREAM_CACHE``); ``ZKSTREAM_NO_CACHE=1`` kills it.
+        #: The ctor beats the env, like every other knob ladder.
+        if cache is None:
+            roots = cache_roots_default()
+        elif cache is True:
+            roots = ['/']
+        elif cache is False:
+            roots = None
+        elif isinstance(cache, str):
+            roots = [cache]
+        else:
+            roots = list(cache)
+        self.cache = (CachePlane(self, roots,
+                                 collector=self.collector)
+                      if roots else None)
         self.pool.on('stateChanged', self._on_pool_state_changed)
         # Degraded-mode surface: re-emit the pool's circuit-breaker
         # edges on the client, count them, and expose the current state
@@ -298,6 +318,8 @@ class Client(FSM):
         self.pool.start()
         if self._read_plane is not None:
             self._read_plane.start()
+        if self.cache is not None:
+            self.cache.start()
 
     async def close(self) -> None:
         """Close the session cleanly and stop the pool."""
@@ -308,6 +330,8 @@ class Client(FSM):
         self.once('close', lambda: fut.done() or fut.set_result(None))
         self.emit('closeAsserted')
         await fut
+        if self.cache is not None:
+            self.cache.close()
         if self._read_plane is not None:
             await self._read_plane.close()
         if self.transport_tier is not None:
@@ -654,7 +678,25 @@ class Client(FSM):
         when its member trails what the plane already showed this
         client (possible inside one connection — the handshake seed
         only covers floors known at attach time), a ``sync`` barrier
-        catches the member up and the read re-issues once."""
+        catches the member up and the read re-issues once.
+
+        The cache plane (README "Client cache plane") consults FIRST:
+        a read under a subscribed, coherent subtree returns locally —
+        no wire round trip at all — and every server reply that does
+        go out deposits back in, read-through."""
+        cache = self.cache
+        if cache is not None and path is not None:
+            out = cache.lookup(opcode, path)
+            if out is not None:
+                # a cached serve is still one observed op: it lands
+                # in the span ring (and the campaign history via
+                # on_op) like any server read, flagged 'cached'
+                span = self.trace.start(opcode, path)
+                span.detail = 'cached'
+                span.finish(zxid=out.get('zxid'))
+                if self.on_op is not None:
+                    self.on_op(span)
+                return out
         plane = self._read_plane
         if plane is not None and plane.started:
             primary = self.pool.current_backend()
@@ -699,6 +741,8 @@ class Client(FSM):
                 deadline)
             out = await self._primary_request(pkt, opcode, path,
                                               deadline)
+        if cache is not None and path is not None:
+            cache.fill(opcode, path, out)
         return out
 
     async def ping(self, deadline=_USE_DEFAULT) -> float:
@@ -914,6 +958,41 @@ class Client(FSM):
             # The client is closing or closed.
             raise ZKNotConnectedError()
         return sess.watcher(path)
+
+    async def add_watch(self, path: str, recursive: bool = False,
+                        deadline=_USE_DEFAULT) -> ZKPersistentWatcher:
+        """Arm a persistent watch (ADD_WATCH, opcode 106) on ``path``
+        — ``recursive=True`` for PERSISTENT_RECURSIVE, matching the
+        whole subtree.  Resolves to the session's
+        :class:`~.io.watcher.ZKPersistentWatcher` emitter: unlike
+        :meth:`watcher`'s one-shot engine it survives fires with no
+        re-arm read, and it replays across reconnects via
+        SET_WATCHES2.  The registration is made BEFORE the round
+        trip, so even if the arm races a disconnect the next
+        reconnect's replay arms it — the returned emitter is live
+        either way (the raised error tells the caller the first arm
+        did not confirm)."""
+        self._check_path(path)
+        sess = self.get_session()
+        if sess is None:
+            raise ZKNotConnectedError()
+        w = sess.persistent_watcher(path, recursive)
+        await self._primary_request(
+            {'opcode': 'ADD_WATCH', 'path': path,
+             'mode': 1 if recursive else 0},
+            'ADD_WATCH', path, deadline)
+        return w
+
+    def remove_persistent_watch(self, path: str) -> None:
+        """Drop a persistent registration client-side.  The
+        server-side subscription dies with the connection's next
+        reconnect (it is simply not replayed); there is no wire op
+        to remove it eagerly, matching the reference's lack of
+        checkWatches support."""
+        self._check_path(path)
+        sess = self.get_session()
+        if sess is not None:
+            sess.drop_persistent_watcher(path)
 
 
 class Transaction:

@@ -670,20 +670,24 @@ class ZKConnection(FSM):
         self._write(pkt)
 
     def set_watches(self, events: dict, rel_zxid: int,
-                    cb: Callable) -> None:
+                    cb: Callable,
+                    opcode: str = 'SET_WATCHES') -> None:
         """Send SET_WATCHES on its reserved xid; a second call while one
         is in flight queues behind it
-        (reference: lib/connection-fsm.js:465-499)."""
+        (reference: lib/connection-fsm.js:465-499).  ``opcode`` selects
+        the five-list SET_WATCHES2 variant when the session also
+        replays persistent (ADD_WATCH) registrations."""
         if not self.is_in_state('connected'):
             raise ZKProtocolError('CONNECTION_LOSS',
                 'Client must be connected to send packets (is in state %s)'
                 % (self.get_state(),))
-        pkt = {'xid': consts.XID_SET_WATCHES, 'opcode': 'SET_WATCHES',
+        pkt = {'xid': consts.XID_SET_WATCHES, 'opcode': opcode,
                'relZxid': rel_zxid, 'events': events}
         existing = self.reqs.get(consts.XID_SET_WATCHES)
         if existing is not None:
             existing.once('reply',
-                lambda _pkt: self.set_watches(events, rel_zxid, cb))
+                lambda _pkt: self.set_watches(events, rel_zxid, cb,
+                                              opcode))
             existing.once('error', lambda err, *a: cb(err))
             return
         req = ZKRequest(pkt)

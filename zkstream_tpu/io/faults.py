@@ -1127,6 +1127,14 @@ class FaultPlan:
     #: draws from a fresh 'churn-overload' stream; part of the
     #: rerun key: ``chaos --overload N``.
     overloads: int = 0
+    #: watch-backed client cache (README "Client cache plane",
+    #: io/cache.py): the schedule's clients run with ``cache='/'`` —
+    #: every read consults the persistent-recursive-watch-backed
+    #: local cache first, and the history must still pass
+    #: check_session_reads (a cached read can never time-travel:
+    #: serve gate + fill gate + invalidation floor).  Part of the
+    #: rerun key: ``chaos --cached``.
+    cached: bool = False
 
     @classmethod
     def randomized(cls, seed: int, ops: int = 12) -> 'FaultPlan':
@@ -1167,6 +1175,11 @@ class FaultPlan:
         # produces the same value
         ovrng = random.Random('plan-overload/%d' % (seed,))
         plan.overloads = ovrng.choice([0, 0, 0, 1, 2])
+        # and for the cache plane (PR 20): the cached-client draw
+        # rides a fresh stream, so every draw existing seeds pinned
+        # still produces the same value
+        carng = random.Random('plan-cache/%d' % (seed,))
+        plan.cached = carng.choice([False, False, False, True])
         return plan
 
     def forced_election_steps(self) -> set[int]:
@@ -1579,7 +1592,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
                                 clients: int | None = None,
                                 observers: int | None = None,
                                 reconfigs: int | None = None,
-                                overloads: int | None = None
+                                overloads: int | None = None,
+                                cached: bool | None = None
                                 ) -> ScheduleResult:
     """Run one seeded ensemble-tier schedule: member churn around a
     client workload, every op recorded into an append-only history,
@@ -1596,7 +1610,7 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         return await run_concurrent_schedule(
             seed, ops=ops, clients=clients, collector=collector,
             plan=plan, elections=elections, observers=observers,
-            reconfigs=reconfigs, overloads=overloads)
+            reconfigs=reconfigs, overloads=overloads, cached=cached)
     from ..client import Client
     from ..protocol.consts import CreateFlag
     from .backoff import BackoffPolicy
@@ -1618,6 +1632,8 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         plan.reconfigs = reconfigs
     if overloads is not None:
         plan.overloads = overloads
+    if cached is not None:
+        plan.cached = cached
     #: observer churn draws ride their own stream (fresh per seed):
     #: attaching observers must not shift any draw existing seeds pin
     orng = random.Random('churn-obs/%d' % (seed,))
@@ -1671,6 +1687,11 @@ async def run_ensemble_schedule(seed: int, ops: int = 12,
         # check_session_reads holds the session-monotone rung
         read_distribution=plan.observers > 0,
         read_subset=plan.read_subset,
+        # --cached: the watch-backed cache plane rides the whole
+        # fault vocabulary; check_session_reads must still hold on
+        # every locally-served read (cache=False pins the knob OFF
+        # regardless of ZKSTREAM_CACHE, keeping schedules seeded)
+        cache='/' if plan.cached else False,
         decoherence_interval=(plan.decoherence_ms
                               if plan.decoherence_ms is not None
                               else DEFAULT_DECOHERENCE_INTERVAL),
@@ -2174,14 +2195,16 @@ async def run_ensemble_campaign(base_seed: int, schedules: int,
                                 clients: int | None = None,
                                 observers: int | None = None,
                                 reconfigs: int | None = None,
-                                overloads: int | None = None
+                                overloads: int | None = None,
+                                cached: bool | None = None
                                 ) -> list[ScheduleResult]:
     """Run ``schedules`` consecutive seeded ensemble schedules
     starting at ``base_seed`` (``clients`` > 1: the concurrent
     tier, every schedule linearizability-checked; ``observers``
     overrides every plan's non-voting member count; ``reconfigs``
     every plan's forced membership-change count; ``overloads``
-    every plan's forced overload-burst count)."""
+    every plan's forced overload-burst count; ``cached`` every
+    plan's watch-backed client-cache draw)."""
     out = []
     for i in range(schedules):
         r = await run_ensemble_schedule(base_seed + i, ops=ops,
@@ -2189,7 +2212,8 @@ async def run_ensemble_campaign(base_seed: int, schedules: int,
                                         clients=clients,
                                         observers=observers,
                                         reconfigs=reconfigs,
-                                        overloads=overloads)
+                                        overloads=overloads,
+                                        cached=cached)
         out.append(r)
         if progress is not None:
             progress(r)
@@ -2231,7 +2255,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
                                   elections: int | None = None,
                                   observers: int | None = None,
                                   reconfigs: int | None = None,
-                                  overloads: int | None = None
+                                  overloads: int | None = None,
+                                  cached: bool | None = None
                                   ) -> ScheduleResult:
     """One seeded concurrent schedule: ``clients`` Clients driven
     from per-client RNG streams drawn fresh from the FaultPlan, each
@@ -2270,6 +2295,8 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
         plan.reconfigs = reconfigs
     if overloads is not None:
         plan.overloads = overloads
+    if cached is not None:
+        plan.cached = cached
     inj = FaultInjector(seed, plan.config)
     res = ScheduleResult(seed=seed, tier='ensemble',
                          clients=clients)
@@ -2330,6 +2357,11 @@ async def run_concurrent_schedule(seed: int, ops: int = 12,
             # history must still pass check_session_reads
             read_distribution=plan.observers > 0,
             read_subset=plan.read_subset,
+            # --cached: every client consults the watch-backed
+            # cache first; contended keys make the invalidation
+            # stream do real work and check_session_reads holds
+            # the no-time-travel rung on every local serve
+            cache='/' if plan.cached else False,
             decoherence_interval=(plan.decoherence_ms
                                   if plan.decoherence_ms is not None
                                   else DEFAULT_DECOHERENCE_INTERVAL),

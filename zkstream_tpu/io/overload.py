@@ -195,7 +195,8 @@ class OverloadPlane:
     disabled path adds zero work to the hot loops)."""
 
     __slots__ = ('server', 'cfg', 'sheds', 'throttled_writes',
-                 'evictions', 'notifications_dropped', 'rx_pauses',
+                 'evictions', 'notifications_dropped',
+                 'persistent_evictions', 'rx_pauses',
                  '_throttled_on', '_win_start', '_win_n', '_agg',
                  '_agg_at', '_ctr_throttled', '_ctr_evicted',
                  '_ctr_dropped', '_hist_tx')
@@ -208,6 +209,7 @@ class OverloadPlane:
         self.throttled_writes = 0
         self.evictions = 0
         self.notifications_dropped = 0
+        self.persistent_evictions = 0
         self.rx_pauses = 0
         self._throttled_on = False
         self._win_start = 0.0
@@ -351,6 +353,29 @@ class OverloadPlane:
                            session_id=_sid(conn))
         return False
 
+    def allow_persistent_notification(self, conn) -> bool:
+        """The soft-watermark gate for PERSISTENT-watch subscribers
+        (server/watchtable.py ``_fan_persistent``).  The one-shot
+        drop contract above is UNSAFE here: a one-shot client re-arms
+        and re-reads on reconnect anyway, but a persistent subscriber
+        — a watch-backed cache — relies on the invalidation stream
+        being gap-free, and a silently dropped frame would leave it
+        serving stale data forever with no signal.  So instead of a
+        gap the stalled subscriber is EVICTED on the spot (typed
+        close, same as the hard watermark): the client observes a
+        connection loss, marks its cached subtree stale, re-dials,
+        replays via SET_WATCHES2 and re-syncs — coherence preserved
+        at the cost of one reconnect."""
+        soft = self.cfg.tx_soft
+        if soft <= 0 or conn.closed:
+            return True
+        b = conn._tx.buffered_bytes()
+        if b < soft:
+            return True
+        self.persistent_evictions += 1
+        self.evict(conn, 'persistent_gap', buffered=b)
+        return False
+
     def check_tx(self, conn) -> bool:
         """Hard-watermark check, called where tx bytes accumulate
         (fan-out flush, ingress drain).  Returns ``True`` if the
@@ -452,6 +477,8 @@ class OverloadPlane:
             ('zk_overload_evictions', self.evictions),
             ('zk_overload_notifications_dropped',
              self.notifications_dropped),
+            ('zk_overload_persistent_evictions',
+             self.persistent_evictions),
             ('zk_overload_tx_buffered_bytes', self.aggregate_tx()),
             ('zk_overload_max_frame',
              getattr(self.server, 'max_frame', MAX_PACKET)),

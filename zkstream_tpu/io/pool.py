@@ -174,6 +174,22 @@ class ReadPlane:
         self.rebalances = 0
         self._resolver.on('changed', self._on_config_change)
 
+    def summary(self) -> dict:
+        """Read-path accounting for bench/campaign reports: where
+        this client's reads actually went.  Reads the cache plane
+        absorbed (README "Client cache plane") never reach this
+        plane at all, so they are reported alongside — the cached
+        arm of ``bench.py --read`` keys on exactly this split."""
+        out = {'distributed': self.distributed,
+               'bounced': self.bounced,
+               'fallbacks': self.fallbacks,
+               'rebalances': self.rebalances}
+        cache = getattr(self._client, 'cache', None)
+        if cache is not None:
+            out['cached'] = cache.hits
+            out['cache_misses'] = cache.misses
+        return out
+
     def _select(self) -> list[Backend]:
         """The ≤``subset`` backends this plane should be dialing.
         Rendezvous hashing (highest crc32(salt|key) wins) keeps the

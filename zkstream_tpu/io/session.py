@@ -26,7 +26,7 @@ from ..utils.fsm import FSM
 from ..utils.logging import Logger
 from ..utils.metrics import Collector
 from .backoff import BackoffPolicy
-from .watcher import ZKWatcher
+from .watcher import ZKPersistentWatcher, ZKWatcher
 
 METRIC_ZK_NOTIFICATION_COUNTER = 'zookeeper_notifications'
 
@@ -58,6 +58,20 @@ class ZKSession(FSM):
         self._expiry_deadline = 0.0
         self._expiry_at = 0.0      # when the pending handle will fire
         self.watchers: dict[str, ZKWatcher] = {}
+        #: Persistent (ADD_WATCH) registrations: path ->
+        #: ZKPersistentWatcher.  Unlike the one-shot map above these
+        #: carry no re-arm FSMs — the server-side subscription
+        #: survives fires — but they ride the same reconnect replay,
+        #: upgraded to SET_WATCHES2 (io/connection.py set_watches).
+        self.persistent_watchers: dict[str, ZKPersistentWatcher] = {}
+        #: The newest zxid any NOTIFICATION stamped (reply zxids live
+        #: in ``last_zxid``).  The watch-backed cache's coherence
+        #: position (io/cache.py) is the max of the two: the server
+        #: never lets a reply overtake an earlier notification on one
+        #: connection (server/watchtable.py ordering contract), so
+        #: everything at or below that max has already been fanned to
+        #: this session's watchers.
+        self.notif_zxid = 0
         self.timeout = timeout
         self.last_attach = 0.0
         self.collector = collector if collector is not None else Collector()
@@ -382,6 +396,7 @@ class ZKSession(FSM):
         self._cancel_expiry_timer()
         self._cancel_rearm_retry()
         self._trace_edge('SESSION_EXPIRED', self.session_id)
+        self._drop_persistent()
         self.log.warning('ZK session expired')
 
     def state_closed(self, S) -> None:
@@ -390,9 +405,21 @@ class ZKSession(FSM):
         self.conn = None
         self._cancel_expiry_timer()
         self._cancel_rearm_retry()
+        self._drop_persistent()
         self.log.info('ZK session closed')
 
     # -- watcher plumbing --
+
+    def _drop_persistent(self) -> None:
+        """Terminal teardown (expired/closed): the server-side
+        registrations die with the session — surface the loss so
+        subscribers re-create them on the replacement session."""
+        pers = self.persistent_watchers
+        if not pers:
+            return
+        self.persistent_watchers = {}
+        for pw in pers.values():
+            pw._lost()
 
     def watchers_disconnected(self) -> None:
         """Tell every armed watch event it is on the auto-resume list
@@ -419,6 +446,37 @@ class ZKSession(FSM):
         watcher = self.watchers.get(pkt['path'])
         if watcher is not None:
             watcher.notify(evt)
+        if self.persistent_watchers:
+            zxid = pkt.get('zxid', 0)
+            if zxid > self.notif_zxid:
+                self.notif_zxid = zxid
+            self._dispatch_persistent(evt, pkt['path'], zxid)
+
+    def _dispatch_persistent(self, evt: str, path: str,
+                             zxid: int) -> None:
+        """Fan one notification to the persistent registrations it
+        matches: the exact node, plus — for everything except
+        childrenChanged — every recursive registration on an ancestor
+        (mirrors the server's ancestor-prefix walk,
+        server/watchtable.py _persistent_subs)."""
+        pers = self.persistent_watchers
+        w = pers.get(path)
+        if w is not None:
+            if evt != 'childrenChanged':
+                w._notify(evt, path, zxid)
+            elif not w.recursive:
+                # recursive subscribers never get childrenChanged:
+                # they see the child's own created/deleted instead
+                w._notify(evt, path, zxid)
+        if evt == 'childrenChanged':
+            return
+        p = path
+        while len(p) > 1:
+            i = p.rfind('/')
+            p = p[:i] if i > 0 else '/'
+            w = pers.get(p)
+            if w is not None and w.recursive:
+                w._notify(evt, path, zxid)
 
     def resume_watches(self) -> None:
         """After reconnect, batch every watch event in 'resuming' into
@@ -449,6 +507,21 @@ class ZKSession(FSM):
                 else:
                     raise AssertionError('unknown event: %s' % (evt,))
                 all_evts.append(event)
+        opcode = 'SET_WATCHES'
+        pers_list: list[ZKPersistentWatcher] = []
+        if self.persistent_watchers:
+            # persistent registrations always replay — arming is
+            # unconditional (nothing to consume server-side), and a
+            # registration made while disconnected arms here for the
+            # first time
+            opcode = 'SET_WATCHES2'
+            events['persistent'] = []
+            events['persistentRecursive'] = []
+            for path, pw in self.persistent_watchers.items():
+                events['persistentRecursive' if pw.recursive
+                       else 'persistent'].append(path)
+                pers_list.append(pw)
+                count += 1
         if count < 1:
             return
         zxid = self.last_zxid
@@ -471,8 +544,12 @@ class ZKSession(FSM):
             self._rearm_backoff.reset()
             for event in all_evts:
                 event.resume()
+            for pw in pers_list:
+                # the gap is closed server-side; derived state
+                # (io/cache.py) resyncs on this edge
+                pw._resumed()
         try:
-            self.conn.set_watches(events, zxid, done)
+            self.conn.set_watches(events, zxid, done, opcode)
         except ZKProtocolError as e:
             # The connection died between 'connected' and this call
             # (reattach churn): not a bug, the events stay 'resuming'
@@ -513,3 +590,23 @@ class ZKSession(FSM):
             w = ZKWatcher(self, path)
             self.watchers[path] = w
         return w
+
+    def persistent_watcher(self, path: str,
+                           recursive: bool) -> ZKPersistentWatcher:
+        """One persistent registration per path.  Registering here
+        alone does NOT arm the server side — the caller sends
+        ADD_WATCH (Client.add_watch) — but once registered the path
+        rides every reconnect's SET_WATCHES2 replay, so a
+        registration that raced a disconnect still arms.  Asking for
+        the same path under a different mode re-homes it (last mode
+        wins, matching the server's re-arm semantics)."""
+        w = self.persistent_watchers.get(path)
+        if w is None:
+            w = ZKPersistentWatcher(self, path, recursive)
+            self.persistent_watchers[path] = w
+        elif w.recursive is not recursive:
+            w.recursive = recursive
+        return w
+
+    def drop_persistent_watcher(self, path: str) -> None:
+        self.persistent_watchers.pop(path, None)

@@ -126,6 +126,55 @@ class ZKWatcher(EventEmitter):
             self.watch_events[evt].arm()
 
 
+class ZKPersistentWatcher(EventEmitter):
+    """One persistent (ADD_WATCH, opcode 106) registration: the
+    client-side half of the watch family the one-shot engine above
+    never had.  No re-arm FSM — the server-side subscription survives
+    fires, so this object is just the session-lifetime emitter plus
+    the replay bookkeeping.
+
+    User events, each emitted with ``(path, zxid)``:
+
+    - ``'created'`` / ``'deleted'`` / ``'dataChanged'`` — for the
+      registered node and, in recursive mode, every descendant;
+    - ``'childrenChanged'`` — exact (non-recursive) mode only: a
+      recursive subscriber sees the child's own CREATED/DELETED
+      instead (upstream PERSISTENT_RECURSIVE semantics);
+    - ``'resumed'`` — the session re-established and the server-side
+      subscription was re-armed via SET_WATCHES2 replay.  Anything
+      may have changed in the gap: a subscriber maintaining derived
+      state (io/cache.py) must resync, not trust it;
+    - ``'lost'`` — the owning session died for good (expired/closed);
+      the registration is gone and must be re-created on a new
+      session.
+
+    Exact-mode registrations dedup on a monotone zxid: the replay
+    catch-up nudge can restate an event the old connection already
+    delivered.  Recursive mode interleaves many paths and stays
+    dedup-free — duplicate delivery after a reconnect is part of its
+    contract (subscribers resync on 'resumed' anyway)."""
+
+    def __init__(self, session, path: str, recursive: bool):
+        super().__init__()
+        self.session = session
+        self.path = path
+        self.recursive = recursive
+        self.last_zxid = 0
+
+    def _notify(self, evt: str, path: str, zxid: int) -> None:
+        if not self.recursive:
+            if zxid <= self.last_zxid:
+                return
+            self.last_zxid = zxid
+        self.emit(evt, path, zxid)
+
+    def _resumed(self) -> None:
+        self.emit('resumed')
+
+    def _lost(self) -> None:
+        self.emit('lost')
+
+
 class ZKWatchEvent(FSM):
     """One watch's arm / re-arm loop (state diagram: reference
     lib/zk-session.js:616-674).  Lives as long as the session."""

@@ -128,9 +128,30 @@ class OpCode(enum.IntEnum):
     AUTH = 100
     SET_WATCHES = 101
     SASL = 102
+    #: This stack's extension beyond the reference client (whose
+    #: consts table stops at SASL): the upstream ZooKeeper 3.6+
+    #: persistent-watch opcode family.  ADD_WATCH arms a watch that
+    #: SURVIVES fires (mode below); SET_WATCHES2 is the reconnect
+    #: replay carrying the two persistent lists alongside the three
+    #: legacy one-shot lists.
+    ADD_WATCH = 106
+    SET_WATCHES2 = 107
     CREATE_SESSION = -10
     CLOSE_SESSION = -11
     ERROR = -1
+
+
+class AddWatchMode(enum.IntEnum):
+    """ADD_WATCH subscription modes (upstream ZooKeeper AddWatchMode).
+
+    PERSISTENT: survives fires on the exact node, receives every
+    notification type.  PERSISTENT_RECURSIVE: survives fires and
+    matches the node plus every descendant, receiving CREATED /
+    DELETED / DATA_CHANGED (no CHILDREN_CHANGED — a recursive
+    subscriber sees the child's own CREATED/DELETED instead)."""
+
+    PERSISTENT = 0
+    PERSISTENT_RECURSIVE = 1
 
 
 class NotificationType(enum.IntEnum):

@@ -141,6 +141,21 @@ class FastEncoder:
                                n if n else -1),
             pb, _INT.pack(pkt['version'])))
 
+    def _rq_add_watch(self, pkt, opnum):
+        # path + mode int — the DELETE shape with AddWatchMode in the
+        # trailing int slot
+        p = pkt['path']
+        m = pkt['mode']
+        if type(p) is not str or not isinstance(m, int) \
+                or not 0 <= m <= 1:
+            return None
+        pb = p.encode('utf-8')
+        n = len(pb)
+        return b''.join((
+            _REQ_PATH_HDR.pack(16 + n, pkt['xid'], opnum,
+                               n if n else -1),
+            pb, _INT.pack(int(m))))
+
     def _rq_set_data(self, pkt, opnum):
         p = pkt['path']
         d = pkt['data']
@@ -366,8 +381,8 @@ class FastEncoder:
 
 
 #: opcode -> (encoder, wire opcode number); keep the COVERAGE in sync
-#: with records._REQ_WRITERS (SET_WATCHES is resume-time-rare and
-#: stays on the spec path, like the C encoder).
+#: with records._REQ_WRITERS (SET_WATCHES / SET_WATCHES2 are
+#: resume-time-rare and stay on the spec path, like the C encoder).
 _REQ_FAST = {
     'GET_CHILDREN': (FastEncoder._rq_path_watch,
                      int(OpCode.GET_CHILDREN)),
@@ -380,6 +395,7 @@ _REQ_FAST = {
     'GET_ACL': (FastEncoder._rq_path, int(OpCode.GET_ACL)),
     'SET_DATA': (FastEncoder._rq_set_data, int(OpCode.SET_DATA)),
     'SYNC': (FastEncoder._rq_path, int(OpCode.SYNC)),
+    'ADD_WATCH': (FastEncoder._rq_add_watch, int(OpCode.ADD_WATCH)),
     'MULTI': (FastEncoder._rq_multi, int(OpCode.MULTI)),
     'CLOSE_SESSION': (FastEncoder._rq_bare, int(OpCode.CLOSE_SESSION)),
     'PING': (FastEncoder._rq_bare, int(OpCode.PING)),

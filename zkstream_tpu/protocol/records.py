@@ -334,23 +334,55 @@ def _write_multi_resp(w: JuteWriter, pkt: dict) -> None:
 #: (reference: lib/zk-buffer.js:233-273).
 SET_WATCHES_KINDS = ('dataChanged', 'createdOrDestroyed', 'childrenChanged')
 
+#: SET_WATCHES2 (opcode 107, upstream ZooKeeper SetWatches2): the
+#: legacy three lists followed by the two persistent-watch lists.
+SET_WATCHES2_KINDS = SET_WATCHES_KINDS + ('persistent',
+                                          'persistentRecursive')
 
-def _write_set_watches(w: JuteWriter, pkt: dict) -> None:
+
+def _write_watch_lists(w: JuteWriter, pkt: dict, kinds) -> None:
     w.write_long(pkt['relZxid'])
     events = pkt.get('events', {})
-    for kind in SET_WATCHES_KINDS:
+    for kind in kinds:
         paths = events.get(kind, ())
         w.write_int(len(paths))
         for p in paths:
             w.write_ustring(p)
 
 
-def _read_set_watches(r: JuteReader, pkt: dict) -> None:
+def _read_watch_lists(r: JuteReader, pkt: dict, kinds) -> None:
     pkt['relZxid'] = r.read_long()
     pkt['events'] = {}
-    for kind in SET_WATCHES_KINDS:
+    for kind in kinds:
         count = r.read_int()
         pkt['events'][kind] = [r.read_ustring() for _ in range(count)]
+
+
+def _write_set_watches(w: JuteWriter, pkt: dict) -> None:
+    _write_watch_lists(w, pkt, SET_WATCHES_KINDS)
+
+
+def _read_set_watches(r: JuteReader, pkt: dict) -> None:
+    _read_watch_lists(r, pkt, SET_WATCHES_KINDS)
+
+
+def _write_set_watches2(w: JuteWriter, pkt: dict) -> None:
+    _write_watch_lists(w, pkt, SET_WATCHES2_KINDS)
+
+
+def _read_set_watches2(r: JuteReader, pkt: dict) -> None:
+    _read_watch_lists(r, pkt, SET_WATCHES2_KINDS)
+
+
+def _write_add_watch(w: JuteWriter, pkt: dict) -> None:
+    # AddWatchRequest: path ustring + mode int (AddWatchMode)
+    w.write_ustring(pkt['path'])
+    w.write_int(pkt['mode'])
+
+
+def _read_add_watch(r: JuteReader, pkt: dict) -> None:
+    pkt['path'] = r.read_ustring()
+    pkt['mode'] = r.read_int()
 
 
 _REQ_WRITERS = {
@@ -364,6 +396,8 @@ _REQ_WRITERS = {
     'SET_DATA': _write_set_data,
     'SYNC': _write_path,
     'SET_WATCHES': _write_set_watches,
+    'SET_WATCHES2': _write_set_watches2,
+    'ADD_WATCH': _write_add_watch,
     'MULTI': _write_multi,
     # Header-only requests (reference: lib/zk-buffer.js:129-132):
     'CLOSE_SESSION': None,
@@ -381,6 +415,8 @@ _REQ_READERS = {
     'SET_DATA': _read_set_data,
     'SYNC': _read_path,
     'SET_WATCHES': _read_set_watches,
+    'SET_WATCHES2': _read_set_watches2,
+    'ADD_WATCH': _read_add_watch,
     'MULTI': _read_multi,
     'CLOSE_SESSION': None,
     'PING': None,
@@ -450,7 +486,8 @@ def _read_notification(r: JuteReader, pkt: dict) -> None:
 #: Reply opcodes whose body is empty — the header error code alone carries
 #: the result (reference: lib/zk-buffer.js:316-325).
 _EMPTY_RESPONSES = frozenset(
-    ('SET_WATCHES', 'PING', 'SYNC', 'DELETE', 'CLOSE_SESSION', 'AUTH'))
+    ('SET_WATCHES', 'SET_WATCHES2', 'ADD_WATCH', 'PING', 'SYNC',
+     'DELETE', 'CLOSE_SESSION', 'AUTH'))
 
 _RESP_READERS = {
     'GET_CHILDREN': _read_get_children_resp,

@@ -1103,7 +1103,8 @@ async def run_process_schedule(seed: int, ops: int = 6,
                                workdir: str | None = None,
                                clients: int | None = None,
                                observers: int = 0,
-                               reconfig: bool = False):
+                               reconfig: bool = False,
+                               cached: bool = False):
     """One seeded OS-process election schedule: spawn ``members``
     symmetric peer processes over per-member WAL dirs, drive a seeded
     workload THROUGH THE LEADER (quorum-commit makes its ack
@@ -1182,6 +1183,10 @@ async def run_process_schedule(seed: int, ops: int = 6,
         c = Client(servers=backends, shuffle_backends=False,
                    session_timeout=12000, op_timeout=3000,
                    seed=seed, read_distribution=observers > 0,
+                   # --cached: the watch-backed cache plane rides
+                   # the OS-process tier too (cache=False pins the
+                   # knob off regardless of ZKSTREAM_CACHE)
+                   cache='/' if cached else False,
                    connect_policy=BackoffPolicy(timeout=2000,
                                                 retries=4, delay=100,
                                                 cap=1000))
@@ -1650,7 +1655,8 @@ async def run_process_campaign(base_seed: int, schedules: int,
                                elections: int | None = None,
                                clients: int | None = None,
                                observers: int | None = None,
-                               reconfig: bool = False):
+                               reconfig: bool = False,
+                               cached: bool = False):
     """Consecutive seeded process-tier schedules from ``base_seed``.
     ``elections`` overrides the per-schedule forced leader-kill count,
     ``clients`` > 1 makes every workload phase concurrent with
@@ -1666,7 +1672,7 @@ async def run_process_campaign(base_seed: int, schedules: int,
             elections=elections if elections is not None else 2,
             clients=clients,
             observers=observers if observers is not None else 0,
-            reconfig=reconfig)
+            reconfig=reconfig, cached=cached)
         out.append(r)
         if progress is not None:
             progress(r)

@@ -275,6 +275,12 @@ class ServerConnection:
         #: ``_arm_*``/``_disarm_*`` helpers.
         self.data_watches: dict[str, bool] = {}
         self.child_watches: dict[str, bool] = {}
+        #: Persistent watches (ADD_WATCH, opcode 106): path -> True
+        #: when the subscription is PERSISTENT_RECURSIVE.  These
+        #: survive fires — nothing in the dispatch path pops them —
+        #: and mirror the WatchTable's persistent/recursive reverse
+        #: indexes exactly like the one-shot dicts above.
+        self.persistent_watches: dict[str, bool] = {}
         self.closed = False
         self._subscribed = False
         #: Sharded fan-out state (server/watchtable.py): notifications
@@ -404,19 +410,30 @@ class ServerConnection:
         pkt.update(body)
         self._send(pkt)
 
-    def notify(self, ntype: str, path: str, zxid: int) -> None:
+    def notify(self, ntype: str, path: str, zxid: int,
+               persistent: bool = False) -> None:
         """Send one watch notification directly (the SET_WATCHES
         catch-up path; event-driven fan-out goes through the server's
         WatchTable instead).  The bytes come from the server-owned
-        encode cache/memo, shared across subscribers."""
+        encode cache/memo, shared across subscribers.
+
+        ``persistent=True`` applies the persistent-subscriber
+        overload contract: the soft watermark EVICTS instead of
+        dropping (a silent gap would wedge a watch-backed cache
+        stale — io/overload.py ``allow_persistent_notification``)."""
         if self.closed:
             return
         ov = self.server.overload
         if ov is not None:
-            # soft tx watermark: a stalled subscriber loses watch
-            # notifications (the legally lossy channel) before it can
-            # bloat the member; the hard watermark evicts it outright
-            if not ov.allow_notification(self):
+            # soft tx watermark: a stalled one-shot subscriber loses
+            # watch notifications (the legally lossy channel) before
+            # it can bloat the member; a stalled PERSISTENT
+            # subscriber is evicted instead — never a silent gap;
+            # the hard watermark evicts either outright
+            if persistent:
+                if not ov.allow_persistent_notification(self):
+                    return
+            elif not ov.allow_notification(self):
                 return
             if ov.check_tx(self):
                 return
@@ -462,20 +479,54 @@ class ServerConnection:
     def _on_created(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
             self.notify('CREATED', path, zxid)
+        if self._persistent_hit(path, False):
+            self.notify('CREATED', path, zxid, persistent=True)
 
     def _on_deleted(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
             self.notify('DELETED', path, zxid)
         if self.child_watches.pop(path, None):
             self.notify('DELETED', path, zxid)
+        if self._persistent_hit(path, False):
+            self.notify('DELETED', path, zxid, persistent=True)
 
     def _on_data_changed(self, path: str, zxid: int) -> None:
         if self.data_watches.pop(path, None):
             self.notify('DATA_CHANGED', path, zxid)
+        if self._persistent_hit(path, False):
+            self.notify('DATA_CHANGED', path, zxid, persistent=True)
 
     def _on_children_changed(self, path: str, zxid: int) -> None:
         if self.child_watches.pop(path, None):
             self.notify('CHILDREN_CHANGED', path, zxid)
+        # recursive subscribers never get CHILDREN_CHANGED: they see
+        # the child's own CREATED/DELETED instead (upstream semantics)
+        if self._persistent_hit(path, True):
+            self.notify('CHILDREN_CHANGED', path, zxid, persistent=True)
+
+    def _persistent_hit(self, path: str, exact_only: bool) -> bool:
+        """Emitter-fallback persistent-watch match: True when this
+        connection holds a persistent watch on ``path`` itself, or —
+        unless ``exact_only`` — a PERSISTENT_RECURSIVE watch on any
+        ancestor.  Never consumes: the subscription survives fires."""
+        pw = self.persistent_watches
+        if not pw:
+            return False
+        if path in pw:
+            if exact_only:
+                # CHILDREN_CHANGED goes only to exact PERSISTENT
+                # subscriptions, not recursive ones
+                return not pw[path]
+            return True
+        if exact_only:
+            return False
+        p = path
+        while len(p) > 1:
+            i = p.rfind('/')
+            p = p[:i] if i > 0 else '/'
+            if pw.get(p):
+                return True
+        return False
 
     # -- watch arming (both paths: connection dict + table index) --
 
@@ -500,6 +551,25 @@ class ServerConnection:
         if self.child_watches.pop(path, None):
             if self.server.watch_table is not None:
                 self.server.watch_table.disarm('child', path, self)
+
+    def _arm_persistent(self, path: str, recursive: bool) -> None:
+        prev = self.persistent_watches.get(path)
+        if prev is recursive:
+            return
+        if prev is not None:
+            # mode change (PERSISTENT <-> PERSISTENT_RECURSIVE):
+            # re-home the subscription in the other reverse index
+            self._disarm_persistent(path)
+        self.persistent_watches[path] = recursive
+        if self.server.watch_table is not None:
+            self.server.watch_table.arm_persistent(path, self, recursive)
+
+    def _disarm_persistent(self, path: str) -> None:
+        recursive = self.persistent_watches.pop(path, None)
+        if recursive is not None:
+            if self.server.watch_table is not None:
+                self.server.watch_table.disarm_persistent(
+                    path, self, recursive)
 
     # -- lifecycle --
 
@@ -937,8 +1007,49 @@ class ServerConnection:
     def _op_set_watches(self, pkt: dict) -> None:
         """Re-arm watches after reconnect, sending catch-up
         notifications for anything that moved past relZxid."""
+        self._replay_one_shot(pkt['relZxid'], pkt['events'])
+        self._reply(pkt['xid'], 'SET_WATCHES')
+
+    def _op_set_watches2(self, pkt: dict) -> None:
+        """SET_WATCHES2 (opcode 107): the five-list replay — the
+        legacy three one-shot kinds plus ``persistent`` and
+        ``persistentRecursive``.  Persistent re-arms always succeed
+        (the subscription survives the reconnect); the catch-up nudge
+        tells the subscriber its gap, so a watch-backed cache knows to
+        refetch rather than trust its pre-disconnect contents."""
         rel = pkt['relZxid']
         events = pkt['events']
+        self._replay_one_shot(rel, events)
+        z = self.store.zxid
+        for path in events.get('persistent', ()):
+            self._arm_persistent(path, False)
+            node = self.store.nodes.get(path)
+            if node is None:
+                self.notify('DELETED', path, z, persistent=True)
+            elif node.mzxid > rel:
+                self.notify('DATA_CHANGED', path, node.mzxid,
+                            persistent=True)
+        for path in events.get('persistentRecursive', ()):
+            self._arm_persistent(path, True)
+            # a subtree gap cannot be replayed per-node without a
+            # change journal; one nudge at the subtree root marks the
+            # whole span dirty and the subscriber refetches
+            if z > rel:
+                self.notify('DATA_CHANGED', path, z, persistent=True)
+        self._reply(pkt['xid'], 'SET_WATCHES2')
+
+    def _op_add_watch(self, pkt: dict) -> None:
+        """ADD_WATCH (opcode 106): arm a persistent (mode 0) or
+        persistent-recursive (mode 1) watch.  Unlike every other watch
+        arm, this one is not a side effect of a read — it is its own
+        round trip, and it survives fires without re-arm."""
+        mode = pkt['mode']
+        if mode not in (0, 1):
+            raise ZKOpError('BAD_ARGUMENTS')
+        self._arm_persistent(pkt['path'], mode == 1)
+        self._reply(pkt['xid'], 'ADD_WATCH')
+
+    def _replay_one_shot(self, rel: int, events: dict) -> None:
         # catch-up decisions run against THIS member's view: a node
         # change the member has not applied yet fires later through the
         # re-armed watch table when the replica applies it
@@ -975,7 +1086,6 @@ class ServerConnection:
                 self.notify('CHILDREN_CHANGED', path, node.pzxid)
             else:
                 self._arm_child(path)
-        self._reply(pkt['xid'], 'SET_WATCHES')
 
 
 class ZKServer:
@@ -1490,6 +1600,21 @@ class ZKServer:
         return sum(len(c.data_watches) + len(c.child_watches)
                    for c in self.conns)
 
+    def persistent_watch_count(self) -> int:
+        """Armed PERSISTENT (non-recursive) watches on this member."""
+        if self.watch_table is not None:
+            return self.watch_table.persistent_count
+        return sum(sum(1 for r in c.persistent_watches.values()
+                       if not r)
+                   for c in self.conns)
+
+    def recursive_watch_count(self) -> int:
+        """Armed PERSISTENT_RECURSIVE watches on this member."""
+        if self.watch_table is not None:
+            return self.watch_table.recursive_count
+        return sum(sum(1 for r in c.persistent_watches.values() if r)
+                   for c in self.conns)
+
     def mode(self) -> str:
         return 'standalone' if self.store is self.db else 'follower'
 
@@ -1725,6 +1850,8 @@ class ZKServer:
             ('zk_elections_total', self.elections_total()),
             ('zk_znode_count', len(self.store.nodes)),
             ('zk_watch_count', self.watch_count()),
+            ('zk_persistent_watches', self.persistent_watch_count()),
+            ('zk_recursive_watches', self.recursive_watch_count()),
             ('zk_outstanding_requests', self.outstanding),
             ('zk_num_alive_connections', len(self.conns)),
             ('zk_packets_received', self.packets_received),

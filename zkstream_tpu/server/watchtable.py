@@ -134,10 +134,23 @@ class WatchTable:
         #: connection watched).
         self.data_index: dict[str, set] = {}
         self.child_index: dict[str, set] = {}
+        #: Persistent-watch indexes (ADD_WATCH, opcode 106): exact
+        #: node subscribers and subtree-root subscribers.  Unlike the
+        #: one-shot indexes above these SURVIVE fires — a store event
+        #: consults them without popping, and a recursive entry
+        #: matches every descendant by ancestor-prefix walk
+        #: (O(path depth) dict hits per event, only when any
+        #: persistent watch exists at all).
+        self.persistent_index: dict[str, set] = {}
+        self.recursive_index: dict[str, set] = {}
         #: Maintained armed-watch count across this member's
         #: connections — what ``mntr``'s ``zk_watch_count`` scrapes,
         #: O(1) instead of summing every connection's dicts.
         self.count = 0
+        #: Persistent registration counts (mntr
+        #: ``zk_persistent_watches`` / ``zk_recursive_watches``).
+        self.persistent_count = 0
+        self.recursive_count = 0
         #: Per-tick encode memo: (type, path, zxid) -> wire bytes.
         #: Cleared at the next tick boundary, so interleaved event
         #: kinds within one tick (a DELETED fanning to both data and
@@ -219,8 +232,21 @@ class WatchTable:
                 if not subs:
                     del self.child_index[path]
                 self.count -= 1
+        for path, recursive in conn.persistent_watches.items():
+            idx = (self.recursive_index if recursive
+                   else self.persistent_index)
+            subs = idx.get(path)
+            if subs is not None:
+                subs.discard(conn)
+                if not subs:
+                    del idx[path]
+                if recursive:
+                    self.recursive_count -= 1
+                else:
+                    self.persistent_count -= 1
         conn.data_watches.clear()
         conn.child_watches.clear()
+        conn.persistent_watches.clear()
         conn._fanout_buf.clear()
 
     # -- arming / disarming (the connection's watch helpers call in) --
@@ -246,12 +272,44 @@ class WatchTable:
                 del idx[path]
             self.count -= 1
 
+    def arm_persistent(self, path: str, conn,
+                       recursive: bool) -> None:
+        """Register one persistent (ADD_WATCH) subscription; the
+        caller guarantees it is not already armed under this mode
+        (``conn.persistent_watches`` is the dedup)."""
+        idx = self.recursive_index if recursive \
+            else self.persistent_index
+        subs = idx.get(path)
+        if subs is None:
+            idx[path] = subs = set()
+        subs.add(conn)
+        if recursive:
+            self.recursive_count += 1
+        else:
+            self.persistent_count += 1
+
+    def disarm_persistent(self, path: str, conn,
+                          recursive: bool) -> None:
+        idx = self.recursive_index if recursive \
+            else self.persistent_index
+        subs = idx.get(path)
+        if subs is not None and conn in subs:
+            subs.discard(conn)
+            if not subs:
+                del idx[path]
+            if recursive:
+                self.recursive_count -= 1
+            else:
+                self.persistent_count -= 1
+
     # -- store event handlers (the O(watchers-on-path) hot path) --
 
     def _on_created(self, path: str, zxid: int) -> None:
         subs = self.data_index.pop(path, None)
         if subs:
             self._fan('CREATED', path, zxid, subs, 'data')
+        if self.persistent_count or self.recursive_count:
+            self._fan_persistent('CREATED', path, zxid)
 
     def _on_deleted(self, path: str, zxid: int) -> None:
         # a connection holding both watch kinds on the path receives
@@ -262,16 +320,121 @@ class WatchTable:
         subs = self.child_index.pop(path, None)
         if subs:
             self._fan('DELETED', path, zxid, subs, 'child')
+        if self.persistent_count or self.recursive_count:
+            self._fan_persistent('DELETED', path, zxid)
 
     def _on_data_changed(self, path: str, zxid: int) -> None:
         subs = self.data_index.pop(path, None)
         if subs:
             self._fan('DATA_CHANGED', path, zxid, subs, 'data')
+        if self.persistent_count or self.recursive_count:
+            self._fan_persistent('DATA_CHANGED', path, zxid)
 
     def _on_children_changed(self, path: str, zxid: int) -> None:
         subs = self.child_index.pop(path, None)
         if subs:
             self._fan('CHILDREN_CHANGED', path, zxid, subs, 'child')
+        if self.persistent_count:
+            # exact-node persistent subscribers only: a recursive
+            # subscriber sees the child's own CREATED/DELETED instead
+            # (upstream PERSISTENT_RECURSIVE semantics)
+            self._fan_persistent('CHILDREN_CHANGED', path, zxid,
+                                 exact_only=True)
+
+    def _persistent_subs(self, path: str,
+                         exact_only: bool = False) -> set | None:
+        """The persistent subscriber set for one store event: exact
+        subscribers on ``path`` plus — unless ``exact_only`` — every
+        recursive subscriber on ``path`` or an ancestor.  A
+        connection holding both registrations gets ONE frame."""
+        subs = None
+        exact = self.persistent_index.get(path)
+        if exact:
+            subs = set(exact)
+        if not exact_only and self.recursive_count:
+            p = path
+            ridx = self.recursive_index
+            while True:
+                r = ridx.get(p)
+                if r:
+                    subs = (subs | r) if subs else set(r)
+                if len(p) <= 1:
+                    break
+                i = p.rfind('/')
+                p = p[:i] if i > 0 else '/'
+        return subs
+
+    def _fan_persistent(self, ntype: str, path: str, zxid: int,
+                        exact_only: bool = False) -> None:
+        """Fan one store event to persistent subscribers.  Unlike
+        :meth:`_fan` nothing is consumed — the registrations survive
+        the fire — and the overload plane's soft-watermark gate is
+        the EVICTING variant: a persistent subscriber never gets a
+        silent notification gap (a dropped invalidation would wedge
+        a watch-backed client cache stale forever), it gets a typed
+        eviction and re-syncs on reconnect."""
+        subs = self._persistent_subs(path, exact_only)
+        if not subs:
+            return
+        data = self.encode(ntype, path, zxid)
+        srv = self.server
+        trace = getattr(srv, 'trace', None)
+        if trace is not None:
+            trace.note('FANOUT', path, zxid=zxid, kind='server',
+                       batch=len(subs),
+                       nbytes=len(data) * len(subs),
+                       detail='PERSISTENT:' + ntype)
+        if srv.faults is not None:
+            # injection boundary: per frame, BEFORE the shard cork
+            for conn in subs:
+                if not conn.closed:
+                    self._enqueue_persistent(conn, data)
+            return
+        srv.packets_sent += len(subs)
+        shards = self._shards
+        sched: list = []
+        ov = getattr(srv, 'overload', None)
+        for conn in subs:
+            if conn.closed:
+                srv.packets_sent -= 1
+                continue
+            if ov is not None \
+                    and not ov.allow_persistent_notification(conn):
+                # the gate EVICTED the stalled subscriber (typed
+                # close) rather than dropping the frame
+                srv.packets_sent -= 1
+                continue
+            buf = conn._fanout_buf
+            if not buf:
+                shard = shards[conn._fanout_shard]
+                shard.dirty.append(conn)
+                if not shard.scheduled:
+                    shard.scheduled = True
+                    sched.append(shard)
+            buf.append(data)
+        if sched:
+            self._schedule_shards(sched)
+
+    def _enqueue_persistent(self, conn, data: bytes) -> None:
+        """The fault-injection-path twin of :meth:`_enqueue` with the
+        persistent overload contract (evict, never silently drop)."""
+        ov = getattr(self.server, 'overload', None)
+        if ov is not None \
+                and not ov.allow_persistent_notification(conn):
+            return
+        self.server.packets_sent += 1
+        fi = self.server.faults
+        if fi is not None and fi.server_tx(conn, data,
+                                           pre=conn._preflush_fanout):
+            return
+        buf = conn._fanout_buf
+        if not buf:
+            shard = self._shards[conn._fanout_shard]
+            shard.dirty.append(conn)
+            if not shard.scheduled:
+                shard.scheduled = True
+                self._schedule_shards([shard])
+        buf.append(data)
 
     def _fan(self, ntype: str, path: str, zxid: int, subs: set,
              kind: str) -> None:

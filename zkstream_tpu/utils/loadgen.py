@@ -49,7 +49,8 @@ def argv(servers, sessions, *, duration=None, count=None, mix=None,
          arm_watch=False, fanout_sets=None, setwatches_storm=False,
          path=None, data=None, stdio_sync=False, src_addrs=None,
          session_timeout_ms=None, close_sessions=False,
-         ensure_path=True, quiet=True) -> list[str] | None:
+         ensure_path=True, quiet=True, cached=False,
+         cached_write_ms=None) -> list[str] | None:
     """The zkloadgen command line for one run, env knobs applied.
     Returns None when the binary can't be built."""
     binary = available()
@@ -102,4 +103,8 @@ def argv(servers, sessions, *, duration=None, count=None, mix=None,
         cmd += ['--no-ensure-path']
     if quiet:
         cmd += ['--quiet']
+    if cached:
+        cmd += ['--cached']
+    if cached_write_ms is not None:
+        cmd += ['--cached-write-ms', str(float(cached_write_ms))]
     return cmd

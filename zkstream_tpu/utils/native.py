@@ -159,7 +159,7 @@ def ensure_lib(timeout: float = 120.0) -> ctypes.CDLL | None:
 # profile in tools/profile_hotpath.py points at), and is loaded with the
 # same version-named-artifact / background-build discipline.
 
-_EXT_ABI_VERSION = 9
+_EXT_ABI_VERSION = 10
 
 _ext = None
 _ext_load_failed = False
@@ -205,8 +205,8 @@ def build_ext() -> str | None:
 #: opcode -> reply-body-layout enum shared with zkwire_ext.c (keep in
 #: sync with records._RESP_READERS / _EMPTY_RESPONSES).
 _EXT_LAYOUTS = {
-    'SET_WATCHES': 0, 'PING': 0, 'SYNC': 0, 'DELETE': 0,
-    'CLOSE_SESSION': 0, 'AUTH': 0,
+    'SET_WATCHES': 0, 'SET_WATCHES2': 0, 'ADD_WATCH': 0, 'PING': 0,
+    'SYNC': 0, 'DELETE': 0, 'CLOSE_SESSION': 0, 'AUTH': 0,
     'GET_CHILDREN': 1, 'GET_CHILDREN2': 2, 'CREATE': 3, 'GET_ACL': 4,
     'GET_DATA': 5, 'EXISTS': 6, 'SET_DATA': 6, 'NOTIFICATION': 7,
     'MULTI': 8,
@@ -214,11 +214,13 @@ _EXT_LAYOUTS = {
 
 #: opcode -> request-body-layout enum (keep in sync with
 #: records._REQ_READERS): 0 empty, 1 path, 2 path+watch, 3 create,
-#: 4 delete, 5 set_data, 6 set_watches, 7 multi.
+#: 4 delete, 5 set_data, 6 set_watches, 7 multi, 8 add_watch,
+#: 9 set_watches2.
 _EXT_REQ_LAYOUTS = {
     'GET_CHILDREN': 2, 'GET_CHILDREN2': 2, 'GET_DATA': 2, 'EXISTS': 2,
     'CREATE': 3, 'DELETE': 4, 'GET_ACL': 1, 'SET_DATA': 5, 'SYNC': 1,
     'SET_WATCHES': 6, 'CLOSE_SESSION': 0, 'PING': 0, 'MULTI': 7,
+    'ADD_WATCH': 8, 'SET_WATCHES2': 9,
 }
 
 #: Opcodes the spec tier decodes but the extension deliberately PUNTS
